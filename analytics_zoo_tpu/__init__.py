@@ -1,0 +1,33 @@
+"""analytics_zoo_tpu — a TPU-native distributed data-analytics + AI framework.
+
+A ground-up rebuild of the capabilities of Analytics Zoo
+(reference: CaiCui/analytics-zoo, a fork of intel-analytics/analytics-zoo)
+designed for TPUs from the start:
+
+- one Python process per TPU host (``jax.distributed``) instead of the
+  reference's Spark/Ray/py4j/JNI runtime sandwich
+  (reference: pyzoo/zoo/orca/common.py, pyzoo/zoo/ray/raycontext.py),
+- parallelism expressed as sharding annotations over a ``jax.sharding.Mesh``
+  with XLA collectives over ICI, replacing the reference's four data-parallel
+  backends (BigDL BlockManager all-reduce, Horovod, torch.distributed Gloo,
+  TF MultiWorkerMirroredStrategy — reference: pyzoo/zoo/orca/learn/*),
+- models as pure JAX functions compiled once by XLA, replacing the
+  py4j→Scala→JNI→MKL-DNN execution tower
+  (reference: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/).
+
+Top-level subpackages (mirroring the reference's layer map, SURVEY.md §1):
+
+- ``core``      — context bootstrap, mesh, config, checkpoint, logging  (L3)
+- ``data``      — XShards host-sharded data + readers + device feed     (L4)
+- ``nn``        — Keras-style layer API on a minimal JAX module system  (L5)
+- ``orca``      — the unified Estimator (fit/evaluate/predict/save/load)(L6)
+- ``orca.automl`` — hp search-space DSL + search engines + AutoEstimator(L7)
+- ``chronos``   — time-series toolkit: TSDataset, forecasters, AutoTS   (L8)
+- ``friesian``  — recsys feature engineering (FeatureTable)             (L8)
+- ``models``    — built-in model zoo (NCF, Wide&Deep, ResNet, BERT, …)  (L8)
+- ``serving``   — batched inference server + client queues              (L9)
+- ``parallel``  — mesh/sharding utilities, ring attention, collectives
+- ``ops``       — Pallas TPU kernels with XLA fallbacks
+"""
+
+__version__ = "0.1.0"

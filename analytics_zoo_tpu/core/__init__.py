@@ -1,0 +1,13 @@
+"""Core runtime: context bootstrap, config, checkpointing, summaries."""
+
+from .config import MeshConfig, ZooConfig
+from .context import (OrcaContext, get_mesh, init_nncontext,
+                      init_orca_context, make_mesh, stop_orca_context)
+from . import checkpoint
+from .summary import SummaryWriter
+
+__all__ = [
+    "MeshConfig", "ZooConfig", "OrcaContext", "get_mesh", "init_nncontext",
+    "init_orca_context", "make_mesh", "stop_orca_context", "checkpoint",
+    "SummaryWriter",
+]

@@ -1,0 +1,163 @@
+"""Typed configuration for the whole framework.
+
+The reference spreads configuration over five ad-hoc layers (SURVEY.md §5.6):
+spark-analytics-zoo.conf defaults, native-threading env vars set by SparkRunner
+(pyzoo/zoo/util/spark.py), ``init_orca_context(**kwargs)``, ``OrcaContext``
+global attributes (pyzoo/zoo/orca/common.py), and the Cluster Serving
+config.yaml (zoo/.../serving/utils/ConfigParser).  Here all of it collapses
+into one dataclass that can be built programmatically or from a YAML/JSON file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class MeshConfig:
+    """Logical device-mesh layout.
+
+    Axis names are the framework-wide contract (also used by sharding rules in
+    ``analytics_zoo_tpu.parallel``):
+
+    - ``data``  : pure data parallelism (batch sharding, gradient psum)
+    - ``fsdp``  : data parallelism with parameter/optimizer sharding
+    - ``seq``   : sequence/context parallelism (ring attention)
+    - ``model`` : tensor parallelism (sharded matmuls)
+    - ``expert``: expert parallelism (MoE)
+
+    A value of 0 means "absorb all remaining devices" (at most one axis may
+    use it); 1 disables the axis.
+    """
+
+    data: int = 0
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+    AXIS_ORDER = ("data", "fsdp", "seq", "model", "expert")
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        """Return a concrete {axis: size} dict covering exactly n_devices."""
+        sizes = {a: getattr(self, a) for a in self.AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == 0]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be 0 (auto), got {wild}")
+        fixed = 1
+        for a, s in sizes.items():
+            if s > 0:
+                fixed *= s
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"fixed mesh axes {sizes} (product {fixed}) do not divide "
+                    f"{n_devices} devices")
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh axes {sizes} cover {fixed} devices but "
+                    f"{n_devices} are available")
+        return sizes
+
+
+@dataclass
+class ZooConfig:
+    """Process-global framework configuration.
+
+    Replaces the reference's OrcaContext knobs (pyzoo/zoo/orca/common.py:
+    ``pandas_read_backend``, ``serialize_data_creation``, ``train_data_store``)
+    and the SparkRunner env-var plumbing with explicit fields.
+    """
+
+    # cluster bootstrap (reference: init_orca_context cluster_mode/cores/...)
+    cluster_mode: str = "local"          # "local" | "multihost"
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None  # jax.distributed world size
+    process_id: Optional[int] = None
+
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # data layer (reference: OrcaContext.pandas_read_backend)
+    pandas_read_backend: str = "pandas"
+    shard_size: Optional[int] = None
+
+    # training
+    default_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"      # matmul/conv dtype on the MXU
+    remat: bool = False                  # jax.checkpoint the model fn
+
+    # logging / summaries (reference: set_tensorboard, TrainSummary)
+    log_dir: str = "/tmp/analytics_zoo_tpu"
+    log_level: str = "INFO"
+
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ZooConfig":
+        """Load from a JSON or YAML file (Cluster Serving config.yaml parity)."""
+        with open(path) as f:
+            text = f.read()
+        data: Dict[str, Any]
+        if path.endswith((".yaml", ".yml")):
+            try:
+                import yaml  # type: ignore
+                data = yaml.safe_load(text)
+            except ImportError:
+                data = _parse_simple_yaml(text)
+        else:
+            data = json.loads(text)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ZooConfig":
+        mesh = MeshConfig(**data.get("mesh", {}))
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in data.items() if k in known and k != "mesh"}
+        extra = {k: v for k, v in data.items() if k not in known}
+        cfg = cls(mesh=mesh, **kwargs)
+        cfg.extra.update(extra)
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _parse_simple_yaml(text: str) -> Dict[str, Any]:
+    """Tiny fallback parser for flat ``key: value`` YAML (no pyyaml dep)."""
+    out: Dict[str, Any] = {}
+    stack = [out]
+    indents = [0]
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("#"):
+            continue
+        indent = len(raw) - len(raw.lstrip())
+        key, _, value = raw.strip().partition(":")
+        value = value.split(" #", 1)[0].strip()
+        while indent < indents[-1]:
+            stack.pop()
+            indents.pop()
+        if not value:
+            child: Dict[str, Any] = {}
+            stack[-1][key] = child
+            stack.append(child)
+            indents.append(indent + 2)
+        else:
+            stack[-1][key] = _coerce(value)
+    return out
+
+
+def _coerce(value: str) -> Any:
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            pass
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    return value.strip("'\"")

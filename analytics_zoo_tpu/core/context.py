@@ -1,0 +1,176 @@
+"""Cluster bootstrap: the TPU-native replacement for init_orca_context.
+
+Reference behavior being replaced (SURVEY.md §2.1, §3.1):
+``init_orca_context`` (pyzoo/zoo/orca/common.py) built a SparkContext
+(pyzoo/zoo/common/nncontext.py, pyzoo/zoo/util/spark.py) and optionally booted
+a Ray cluster inside the Spark executors (pyzoo/zoo/ray/raycontext.py), giving
+two overlapping clusters on the same nodes.  On TPU the idiomatic shape is one
+Python process per TPU host: ``jax.distributed.initialize`` for multi-host
+coordination over DCN, and a ``jax.sharding.Mesh`` over all chips with XLA
+collectives over ICI.  The five transports of the reference (BlockManager,
+Gloo, gRPC, plasma, py4j) collapse into this single compiled plane.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .config import MeshConfig, ZooConfig
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class _ZooContextMeta(type):
+    """Metaclass exposing process-global knobs as class attributes, mirroring
+    the reference's OrcaContext metaclass pattern (pyzoo/zoo/orca/common.py)."""
+
+    _config: Optional[ZooConfig] = None
+    _mesh: Optional[jax.sharding.Mesh] = None
+    _lock = threading.RLock()
+
+    @property
+    def config(cls) -> ZooConfig:
+        if cls._config is None:
+            raise RuntimeError(
+                "context not initialized — call init_orca_context() first")
+        return cls._config
+
+    @property
+    def initialized(cls) -> bool:
+        return cls._config is not None
+
+    @property
+    def mesh(cls) -> jax.sharding.Mesh:
+        if cls._mesh is None:
+            raise RuntimeError(
+                "context not initialized — call init_orca_context() first")
+        return cls._mesh
+
+    # reference-parity knobs
+    @property
+    def pandas_read_backend(cls) -> str:
+        return cls.config.pandas_read_backend
+
+    @pandas_read_backend.setter
+    def pandas_read_backend(cls, value: str) -> None:
+        cls.config.pandas_read_backend = value
+
+
+class OrcaContext(metaclass=_ZooContextMeta):
+    """Process-global context singleton (reference: pyzoo/zoo/orca/common.py)."""
+
+
+def make_mesh(mesh_shape: Optional[Dict[str, int] | MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None,
+              ) -> jax.sharding.Mesh:
+    """Build a Mesh over the given devices.
+
+    ``mesh_shape`` is a MeshConfig or a {axis: size} dict (see MeshConfig);
+    one axis may be 0 to absorb the remaining devices.  Defaults to pure data
+    parallelism over all devices — the only parallelism the reference had
+    (SURVEY.md §2.9).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if isinstance(mesh_shape, MeshConfig):
+        cfg = mesh_shape
+    else:
+        cfg = MeshConfig(**(mesh_shape or {"data": 0}))
+    sizes = cfg.resolved(len(devices))
+    axes = [a for a in MeshConfig.AXIS_ORDER if sizes[a] > 1]
+    if not axes:  # single device: keep a 1-sized data axis so psum still works
+        axes = ["data"]
+    shape = tuple(sizes[a] for a in axes)
+    dev_array = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+    return jax.sharding.Mesh(dev_array, tuple(axes))
+
+
+def init_orca_context(cluster_mode: str = "local",
+                      mesh_shape: Optional[Dict[str, int]] = None,
+                      config: Optional[ZooConfig] = None,
+                      coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None,
+                      log_level: Optional[str] = None,
+                      **extra: Any) -> jax.sharding.Mesh:
+    """Initialize the process-global context and device mesh.
+
+    API parity with the reference's ``init_orca_context`` (pyzoo/zoo/orca/
+    common.py) — ``cluster_mode`` selects local vs multi-host, everything else
+    that used to configure Spark/Ray is subsumed by the mesh + ZooConfig.
+
+    cluster_mode:
+      - "local":      this process's devices only (1 TPU host or CPU sim).
+      - "multihost":  call ``jax.distributed.initialize`` first so
+                      ``jax.devices()`` spans all hosts (DCN coordination,
+                      ICI/DCN collectives compiled by XLA).
+    Returns the global Mesh.
+    """
+    with _ZooContextMeta._lock:
+        if OrcaContext.initialized:
+            logger.warning("init_orca_context called twice; reusing context")
+            return OrcaContext.mesh
+
+        cfg = config or ZooConfig()
+        cfg.cluster_mode = cluster_mode
+        if mesh_shape:
+            cfg.mesh = MeshConfig(**mesh_shape)
+        if coordinator_address:
+            cfg.coordinator_address = coordinator_address
+        if num_processes is not None:
+            cfg.num_processes = num_processes
+        if process_id is not None:
+            cfg.process_id = process_id
+        if log_level:
+            cfg.log_level = log_level
+        cfg.extra.update(extra)
+
+        logging.basicConfig(level=getattr(logging, cfg.log_level, logging.INFO))
+        logger.setLevel(getattr(logging, cfg.log_level, logging.INFO))
+
+        if cluster_mode == "multihost":
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id)
+        elif cluster_mode != "local":
+            raise ValueError(
+                f"unknown cluster_mode {cluster_mode!r}; the reference's "
+                "yarn/k8s/standalone modes map to 'multihost' here (resource "
+                "management is the TPU platform's job, not the framework's)")
+
+        _ZooContextMeta._mesh = make_mesh(cfg.mesh)
+        _ZooContextMeta._config = cfg
+        logger.info("initialized context: %d device(s), mesh %s",
+                    len(jax.devices()),
+                    dict(zip(OrcaContext.mesh.axis_names,
+                             OrcaContext.mesh.devices.shape)))
+        atexit.register(stop_orca_context)
+        return OrcaContext.mesh
+
+
+def stop_orca_context() -> None:
+    """Tear down the global context (reference: stop_orca_context — which had
+    to kill Ray raylets and the SparkContext; here there is nothing to kill
+    beyond forgetting the globals, since collectives are compiled, not
+    daemonized)."""
+    with _ZooContextMeta._lock:
+        _ZooContextMeta._config = None
+        _ZooContextMeta._mesh = None
+
+
+def get_mesh() -> jax.sharding.Mesh:
+    """The global mesh, initializing a local default context if needed."""
+    if not OrcaContext.initialized:
+        init_orca_context("local")
+    return OrcaContext.mesh
+
+
+# Reference-parity aliases (pyzoo/zoo/common/nncontext.py exposed several
+# spellings of "give me a context").
+init_nncontext = init_orca_context

@@ -1,0 +1,38 @@
+"""Test fixtures: an 8-device CPU "cluster in a box".
+
+Reference test strategy (SURVEY.md §4): the universal trick was ``local[N]``
+Spark + Ray local mode so real all-reduce code paths run as processes on one
+machine.  The TPU-native analog is an 8-device virtual CPU mesh — real XLA
+collectives (psum/all_gather/ppermute) execute, no hardware needed.
+
+Env vars must be set before jax initializes its backends, hence at import.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may import jax (and register a TPU platform)
+# before this conftest runs, making the env vars above too late; the config
+# update below works as long as no backend has been *used* yet.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Each test starts with no global context."""
+    from analytics_zoo_tpu.core import stop_orca_context
+    stop_orca_context()
+    yield
+    stop_orca_context()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

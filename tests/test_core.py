@@ -1,0 +1,124 @@
+"""Core runtime tests: context/mesh bootstrap, config, checkpoint round-trip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import (MeshConfig, OrcaContext, ZooConfig,
+                                    checkpoint, get_mesh, init_orca_context,
+                                    make_mesh, stop_orca_context)
+
+
+def test_init_local_context_default_mesh():
+    mesh = init_orca_context("local")
+    assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+    assert mesh.axis_names == ("data",)
+    assert OrcaContext.initialized
+    assert OrcaContext.mesh is mesh
+
+
+def test_init_twice_reuses():
+    m1 = init_orca_context("local")
+    m2 = init_orca_context("local")
+    assert m1 is m2
+
+
+def test_mesh_shape_axes():
+    mesh = init_orca_context("local", mesh_shape={"data": 2, "model": 4})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "model": 4}
+
+
+def test_mesh_auto_axis():
+    mesh = make_mesh({"data": 0, "model": 2})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "model": 2}
+
+
+def test_mesh_bad_shape_raises():
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshConfig(data=0, model=0).resolved(8)  # two wildcards
+
+
+def test_get_mesh_autoinit():
+    mesh = get_mesh()
+    assert mesh.devices.size == 8
+
+
+def test_psum_on_mesh():
+    """Real collective on the virtual mesh — the backbone of data parallelism."""
+    mesh = init_orca_context("local")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+
+    def f(v):
+        return jax.lax.psum(v.sum(), "data")
+
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=P("data", None), out_specs=P())
+    )(xs)
+    assert float(out) == x.sum()
+
+
+def test_config_from_dict_and_extra():
+    cfg = ZooConfig.from_dict({
+        "cluster_mode": "local",
+        "mesh": {"data": 2, "model": 4},
+        "custom_knob": 42,
+    })
+    assert cfg.mesh.model == 4
+    assert cfg.extra["custom_knob"] == 42
+
+
+def test_config_yaml_fallback(tmp_path):
+    p = tmp_path / "conf.yaml"
+    p.write_text("cluster_mode: local\nmesh:\n  data: 2\n  model: 4\n"
+                 "pandas_read_backend: pandas\nremat: true\n")
+    cfg = ZooConfig.from_file(str(p))
+    assert cfg.mesh.model == 4
+    assert cfg.remat is True
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tree = {
+        "params": {"dense": {"w": np.ones((3, 4), np.float32),
+                             "b": np.zeros((4,), np.float32)}},
+        "step": 7,
+        "lr": 0.1,
+        "name": "m",
+        "flags": (True, None),
+        "history": [np.arange(5), 2.5],
+    }
+    path = checkpoint.save(str(tmp_path / "ckpt"), tree, step=7)
+    back = checkpoint.restore(path)
+    assert back["step"] == 7 and back["lr"] == 0.1 and back["name"] == "m"
+    assert back["flags"] == (True, None)
+    np.testing.assert_array_equal(back["params"]["dense"]["w"], tree["params"]["dense"]["w"])
+    np.testing.assert_array_equal(back["history"][0], np.arange(5))
+    assert checkpoint.latest_step(path) == 7
+    assert checkpoint.exists(path)
+
+
+def test_checkpoint_jax_arrays(tmp_path):
+    tree = {"w": jnp.ones((2, 2)) * 3}
+    path = checkpoint.save(str(tmp_path / "c"), tree)
+    back = checkpoint.restore(path)
+    np.testing.assert_array_equal(back["w"], np.ones((2, 2)) * 3)
+
+
+def test_summary_writer(tmp_path):
+    from analytics_zoo_tpu.core import SummaryWriter
+    w = SummaryWriter(str(tmp_path), "train")
+    for i in range(3):
+        w.add_scalar("loss", 1.0 / (i + 1), i)
+    w.close()
+    scalars = SummaryWriter(str(tmp_path), "train").read_scalar("loss")
+    assert [s for s, _ in scalars] == [0, 1, 2]
+    assert scalars[0][1] == 1.0
