@@ -43,7 +43,12 @@ class MeshConfig:
     AXIS_ORDER = ("data", "fsdp", "seq", "model", "expert")
 
     def resolved(self, n_devices: int) -> Dict[str, int]:
-        """Return a concrete {axis: size} dict covering exactly n_devices."""
+        """Return a concrete {axis: size} dict.
+
+        Covers exactly n_devices when a wildcard (0) axis is present;
+        otherwise the fixed product may be smaller than n_devices (a subset
+        mesh, e.g. debugging on one chip of a multi-chip host) but never
+        larger.  Callers that need full coverage must check the product."""
         sizes = {a: getattr(self, a) for a in self.AXIS_ORDER}
         wild = [a for a, s in sizes.items() if s == 0]
         if len(wild) > 1:
@@ -59,10 +64,12 @@ class MeshConfig:
                     f"{n_devices} devices")
             sizes[wild[0]] = n_devices // fixed
         else:
-            if fixed != n_devices:
+            if fixed > n_devices:
                 raise ValueError(
-                    f"mesh axes {sizes} cover {fixed} devices but "
+                    f"mesh axes {sizes} need {fixed} devices but only "
                     f"{n_devices} are available")
+            # fixed < n_devices is allowed: run on a subset (e.g. debugging
+            # with {"data": 1} on a multi-chip host)
         return sizes
 
 

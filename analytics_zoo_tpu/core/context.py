@@ -86,7 +86,18 @@ def make_mesh(mesh_shape: Optional[Dict[str, int] | MeshConfig] = None,
     if not axes:  # single device: keep a 1-sized data axis so psum still works
         axes = ["data"]
     shape = tuple(sizes[a] for a in axes)
-    dev_array = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+    used = int(np.prod(shape))
+    if used < len(devices):
+        if jax.process_count() > 1:
+            # A subset mesh in multihost SPMD would leave some processes with
+            # no addressable devices in the mesh — collectives would hang.
+            raise ValueError(
+                f"mesh covers {used} of {len(devices)} devices; subset meshes "
+                "are not allowed in multihost mode (every process must own "
+                "mesh devices). Use a wildcard axis (size 0) to cover all.")
+        logger.warning("mesh covers %d of %d available devices; the rest "
+                       "are idle", used, len(devices))
+    dev_array = np.asarray(devices[:used]).reshape(shape)
     return jax.sharding.Mesh(dev_array, tuple(axes))
 
 

@@ -1,0 +1,13 @@
+"""Data layer: XShards, file readers, device feed (reference L4, SURVEY.md §2.2)."""
+
+from .feed import DataFeed, as_feed, batch_sharding, shard_batch
+from .readers import read_csv, read_json, read_npz, read_parquet
+from .shards import XShards
+
+# reference-parity namespace: zoo.orca.data.pandas.read_csv
+from . import readers as pandas  # noqa: F401
+
+__all__ = [
+    "XShards", "DataFeed", "as_feed", "batch_sharding", "shard_batch",
+    "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
+]

@@ -1,0 +1,175 @@
+"""DataFeed: host-side batching + prefetch feeding the device mesh.
+
+Reference (SURVEY.md §2.2, §3.2): data reached compute through per-framework
+feeders — BigDL MiniBatch from FeatureSet, ``tf.data`` per TFRunner actor,
+torch DataLoader per TorchRunner — all downstream of a Spark→Ray object-store
+hop.  TPU-native: each host process batches its local numpy data and places
+it directly onto its devices, sharded along the mesh's batch axes
+(``data``/``fsdp``).  XLA overlaps the host→HBM copy of batch N+1 with the
+compute of batch N because ``jax.device_put`` dispatches asynchronously; we
+additionally keep a one-batch lookahead so the host-side slicing/stacking is
+off the critical path.
+
+Static shapes: batches are fixed-size (remainder dropped or padded) so the
+``jit``-compiled train step compiles exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .shards import XShards
+
+BATCH_AXES = ("data", "fsdp")  # mesh axes a batch dim is sharded over
+
+
+def batch_sharding(mesh: Mesh, leaf_rank: int = 1) -> NamedSharding:
+    """NamedSharding that shards dim 0 over the mesh's batch axes."""
+    present = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    spec = P(present if present else None, *([None] * (leaf_rank - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def batch_axis_size(mesh: Mesh) -> int:
+    size = 1
+    for a in BATCH_AXES:
+        if a in mesh.axis_names:
+            size *= mesh.shape[a]
+    return size
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place a host-local pytree of numpy arrays onto the mesh.
+
+    Single-process: ``device_put`` splits the global batch across devices.
+    Multi-process: each process passes its *local* slice and
+    ``make_array_from_process_local_data`` assembles the global logical array
+    (the SPMD contract: global batch = concat of per-host batches).
+    """
+    multi = jax.process_count() > 1
+
+    def place(leaf: np.ndarray) -> jax.Array:
+        leaf = np.asarray(leaf)
+        sharding = batch_sharding(mesh, max(leaf.ndim, 1))
+        if multi:
+            return jax.make_array_from_process_local_data(sharding, leaf)
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+class DataFeed:
+    """An epoch-iterable source of device-resident, mesh-sharded batches.
+
+    ``batch_size`` is the **global** batch (reference Estimator semantics:
+    pyzoo/zoo/orca/learn/pytorch/pytorch_ray_estimator.py divided it across
+    workers); each host contributes batch_size / process_count rows.
+    """
+
+    def __init__(self, data: Dict[str, Any], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = True):
+        if "x" not in data:
+            raise ValueError("DataFeed requires at least an 'x' entry")
+        self._data = {k: v for k, v in data.items()}
+        self._n = _nrows(self._data["x"])
+        for k, v in self._data.items():
+            if _nrows(v) != self._n:
+                raise ValueError(
+                    f"feature/label row mismatch: {k} has {_nrows(v)} rows, "
+                    f"x has {self._n}")
+        self.global_batch = batch_size
+        self._local_batch = max(1, batch_size // max(1, jax.process_count()))
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+
+    # -- constructors ---------------------------------------------------------
+
+    @staticmethod
+    def from_arrays(x: Any, y: Any = None, batch_size: int = 32,
+                    **kw: Any) -> "DataFeed":
+        data = {"x": x}
+        if y is not None:
+            data["y"] = y
+        return DataFeed(data, batch_size, **kw)
+
+    @staticmethod
+    def from_shards(shards: XShards, batch_size: int = 32,
+                    **kw: Any) -> "DataFeed":
+        """Numpy-dict XShards ({"x": ..., "y": ...}) → DataFeed."""
+        data = shards.concatenated()
+        if not isinstance(data, dict):
+            data = {"x": data}
+        return DataFeed(data, batch_size, **kw)
+
+    # -- iteration ------------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self._n // self._local_batch
+        return -(-self._n // self._local_batch)
+
+    def epoch(self, mesh: Mesh, epoch_idx: int = 0
+              ) -> Iterator[Dict[str, jax.Array]]:
+        """Yield mesh-sharded batches for one epoch (one-batch lookahead)."""
+        steps = self.steps_per_epoch()
+        if steps == 0:
+            raise ValueError(
+                f"dataset of {self._n} rows yields no batches of local size "
+                f"{self._local_batch}")
+        idx = np.arange(self._n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch_idx).shuffle(idx)
+
+        def host_batch(step: int) -> Dict[str, np.ndarray]:
+            sel = idx[step * self._local_batch:(step + 1) * self._local_batch]
+            if len(sel) < self._local_batch:  # pad the last partial batch
+                pad = np.resize(sel, self._local_batch)
+                sel = pad
+            return jax.tree_util.tree_map(
+                lambda a: _take(a, sel), self._data)
+
+        pending = shard_batch(host_batch(0), mesh)
+        for step in range(steps):
+            nxt = (shard_batch(host_batch(step + 1), mesh)
+                   if step + 1 < steps else None)
+            yield pending
+            pending = nxt
+
+
+def as_feed(data: Any, batch_size: int, **kw: Any) -> DataFeed:
+    """Coerce the estimator's accepted data forms into a DataFeed.
+
+    Accepts: DataFeed (passthrough), XShards of numpy dicts, a (x, y) tuple,
+    a dict {"x": ..., "y": ...}, or a bare array (unsupervised).
+    """
+    if isinstance(data, DataFeed):
+        return data
+    if isinstance(data, XShards):
+        return DataFeed.from_shards(data, batch_size, **kw)
+    if isinstance(data, dict):
+        return DataFeed(data, batch_size, **kw)
+    if isinstance(data, tuple) and len(data) == 2:
+        return DataFeed.from_arrays(data[0], data[1], batch_size, **kw)
+    return DataFeed.from_arrays(data, None, batch_size, **kw)
+
+
+def _nrows(v: Any) -> int:
+    if isinstance(v, (tuple, list)):
+        return _nrows(v[0])
+    if isinstance(v, dict):
+        return _nrows(next(iter(v.values())))
+    return len(v)
+
+
+def _take(a: Any, sel: np.ndarray) -> np.ndarray:
+    return np.asarray(a)[sel]

@@ -1,0 +1,91 @@
+"""Distributed file readers producing XShards.
+
+Reference (SURVEY.md §2.2): ``orca.data.pandas.read_csv/read_json``
+(pyzoo/zoo/orca/data/pandas/preprocessing.py) read files into SparkXShards
+with a backend switch ("spark" | "pandas").
+
+TPU-native: files are globbed, the file list is split across host processes
+(process i of N takes files i, i+N, …), and each host reads its files into
+local shards in parallel.  This matches how per-host input pipelines feed TPU
+infeed — no driver hop, no shuffle.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from .shards import XShards
+
+
+def _expand(file_path: str, extensions: Sequence[str]) -> List[str]:
+    if os.path.isdir(file_path):
+        files = sorted(
+            f for f in glob.glob(os.path.join(file_path, "**", "*"),
+                                 recursive=True)
+            if os.path.isfile(f) and f.endswith(tuple(extensions)))
+    else:
+        files = sorted(glob.glob(file_path))
+    if not files:
+        raise FileNotFoundError(f"no files match {file_path!r}")
+    return files
+
+
+def _my_files(files: List[str]) -> List[str]:
+    """This host's slice of the global file list (SPMD round-robin)."""
+    pid, n = jax.process_index(), jax.process_count()
+    mine = files[pid::n]
+    if not mine and files:
+        # fewer files than hosts: everyone reads file (pid mod len) so no host
+        # is starved; estimators drop duplicate contributions via batch math
+        mine = [files[pid % len(files)]]
+    return mine
+
+
+def read_csv(file_path: str, num_shards: Optional[int] = None,
+             **kwargs: Any) -> XShards:
+    """Read CSV file(s)/glob/dir into pandas-DataFrame XShards."""
+    import pandas as pd
+    files = _my_files(_expand(file_path, (".csv",)))
+    shards = XShards(files).transform_shard(
+        lambda f: pd.read_csv(f, **kwargs))
+    if num_shards and num_shards != shards.num_partitions():
+        shards = shards.repartition(num_shards)
+    return shards
+
+
+def read_json(file_path: str, num_shards: Optional[int] = None,
+              **kwargs: Any) -> XShards:
+    import pandas as pd
+    files = _my_files(_expand(file_path, (".json", ".jsonl")))
+    shards = XShards(files).transform_shard(
+        lambda f: pd.read_json(f, **kwargs))
+    if num_shards and num_shards != shards.num_partitions():
+        shards = shards.repartition(num_shards)
+    return shards
+
+
+def read_parquet(file_path: str, num_shards: Optional[int] = None,
+                 **kwargs: Any) -> XShards:
+    import pandas as pd
+    files = _my_files(_expand(file_path, (".parquet", ".pq")))
+    shards = XShards(files).transform_shard(
+        lambda f: pd.read_parquet(f, **kwargs))
+    if num_shards and num_shards != shards.num_partitions():
+        shards = shards.repartition(num_shards)
+    return shards
+
+
+def read_npz(file_path: str, keys: Optional[Sequence[str]] = None) -> XShards:
+    """Read .npz archives into numpy-dict shards (one shard per file)."""
+    files = _my_files(_expand(file_path, (".npz",)))
+
+    def load(f):
+        with np.load(f) as z:
+            return {k: z[k] for k in (keys or z.files)}
+    return XShards(files).transform_shard(load)

@@ -1,0 +1,25 @@
+"""Keras-style NN layer API on a minimal JAX module system (reference L5)."""
+
+from . import activations, initializers, losses, metrics
+from .attention import (MultiHeadAttention, TransformerLayer,
+                        dot_product_attention)
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv1D, Conv2D, Dense, Dropout, Embedding,
+                     Flatten, GlobalAveragePooling1D, GlobalAveragePooling2D,
+                     GlobalMaxPooling1D, GlobalMaxPooling2D, Lambda,
+                     LayerNormalization, MaxPooling2D, Multiply, Reshape,
+                     Sequential, ZeroPadding2D)
+from .module import Module, Scope, param_count
+from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
+
+__all__ = [
+    "activations", "initializers", "losses", "metrics",
+    "Module", "Scope", "param_count",
+    "Dense", "Embedding", "Dropout", "Flatten", "Reshape", "Activation",
+    "Lambda", "Conv1D", "Conv2D", "MaxPooling2D", "AveragePooling2D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling2D", "GlobalAveragePooling1D",
+    "GlobalMaxPooling1D", "ZeroPadding2D", "BatchNormalization",
+    "LayerNormalization", "Concatenate", "Add", "Multiply", "Sequential",
+    "LSTM", "GRU", "SimpleRNN", "Bidirectional", "TimeDistributed",
+    "MultiHeadAttention", "TransformerLayer", "dot_product_attention",
+]

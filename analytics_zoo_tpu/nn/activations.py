@@ -1,0 +1,38 @@
+"""Activation registry (reference: Keras-zoo activation layers,
+zoo/.../pipeline/api/keras/layers/ activation classes)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "softplus": jax.nn.softplus,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get(act: Union[str, Callable, None]) -> Callable:
+    if callable(act):
+        return act
+    try:
+        return ACTIVATIONS[act]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {act!r}; known: "
+            f"{sorted(k for k in ACTIVATIONS if k)}") from None

@@ -1,0 +1,125 @@
+"""Attention layers: MultiHeadAttention + Transformer encoder block.
+
+Reference (SURVEY.md §2.3, §5.7): the Scala Keras zoo's TransformerLayer/BERT
+self-attention layers (zoo/.../pipeline/api/keras/layers/ self-attention
+area), replicated per-worker with seq≤512 on CPU.
+
+TPU-native: batched einsum attention that XLA fuses onto the MXU, with an
+optional fused-kernel hook — ``analytics_zoo_tpu.ops.flash_attention``
+(Pallas) is used when available for long sequences, and ring attention over a
+``seq`` mesh axis lives in ``analytics_zoo_tpu.parallel.ring_attention``
+(capability the reference lacked; SURVEY.md §5.7 'post-parity stretch').
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import initializers
+from .layers import Dense, Dropout, LayerNormalization
+from .module import Module, Scope
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          ) -> jax.Array:
+    """Plain attention: q,k,v [B, T, H, D] → [B, T, H, D].
+
+    mask: broadcastable to [B, H, Tq, Tk]; 1 = attend, 0 = masked.
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(d, logits.dtype))
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+class MultiHeadAttention(Module):
+    def __init__(self, num_heads: int, head_dim: Optional[int] = None,
+                 dropout: float = 0.0, use_flash: bool = False,
+                 dtype: Optional[Any] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.dropout = dropout
+        self.use_flash = use_flash
+        self.dtype = dtype
+
+    def forward(self, scope: Scope, x: jax.Array,
+                kv: Optional[jax.Array] = None,
+                mask: Optional[jax.Array] = None) -> jax.Array:
+        kv = x if kv is None else kv
+        d_model = x.shape[-1]
+        h = self.num_heads
+        d_head = self.head_dim or d_model // h
+        init = initializers.get("glorot_uniform")
+
+        def proj(name: str, src: jax.Array) -> jax.Array:
+            w = scope.param(name, init, (src.shape[-1], h * d_head))
+            y = jnp.dot(x if name == "wq" else src, w,
+                        preferred_element_type=jnp.float32).astype(src.dtype)
+            return y.reshape(src.shape[:-1] + (h, d_head))
+
+        q = proj("wq", x)
+        k = proj("wk", kv)
+        v = proj("wv", kv)
+
+        if self.use_flash and mask is None:
+            from analytics_zoo_tpu.ops import flash_attention
+            ctx = flash_attention(q, k, v)
+        else:
+            ctx = dot_product_attention(q, k, v, mask)
+
+        wo = scope.param("wo", init, (h * d_head, d_model))
+        out = jnp.dot(ctx.reshape(x.shape[:-1] + (h * d_head,)), wo,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.dropout > 0 and scope.training:
+            keep = 1.0 - self.dropout
+            m = jax.random.bernoulli(scope.make_rng(), keep, out.shape)
+            out = jnp.where(m, out / keep, 0.0)
+        return out
+
+
+class TransformerLayer(Module):
+    """Pre/post-LN transformer encoder block (reference: keras/layers
+    TransformerLayer)."""
+
+    def __init__(self, num_heads: int, hidden_mult: int = 4,
+                 dropout: float = 0.0, pre_ln: bool = False,
+                 use_flash: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.mha = MultiHeadAttention(num_heads, dropout=dropout,
+                                      use_flash=use_flash)
+        self.hidden_mult = hidden_mult
+        self.dropout = dropout
+        self.pre_ln = pre_ln
+
+    def forward(self, scope: Scope, x: jax.Array,
+                mask: Optional[jax.Array] = None) -> jax.Array:
+        d_model = x.shape[-1]
+        ln1 = LayerNormalization(name="ln1")
+        ln2 = LayerNormalization(name="ln2")
+        ffn1 = Dense(d_model * self.hidden_mult, activation="gelu", name="ffn1")
+        ffn2 = Dense(d_model, name="ffn2")
+        drop = Dropout(self.dropout, name="drop")
+
+        if self.pre_ln:
+            a = scope.child(self.mha, scope.child(ln1, x, name="ln1"),
+                            mask=mask, name="mha")
+            x = x + scope.child(drop, a, name="drop1")
+            f = scope.child(ln2, x, name="ln2")
+            f = scope.child(ffn2, scope.child(ffn1, f, name="ffn1"),
+                            name="ffn2")
+            return x + scope.child(drop, f, name="drop2")
+        a = scope.child(self.mha, x, mask=mask, name="mha")
+        x = scope.child(ln1, x + scope.child(drop, a, name="drop1"),
+                        name="ln1")
+        f = scope.child(ffn2, scope.child(ffn1, x, name="ffn1"), name="ffn2")
+        return scope.child(ln2, x + scope.child(drop, f, name="drop2"),
+                           name="ln2")

@@ -1,0 +1,32 @@
+"""Weight initializers (reference: BigDL InitializationMethod zoo exposed
+through the Keras layers' ``init=`` argument)."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+
+INITIALIZERS = {
+    "glorot_uniform": jax.nn.initializers.glorot_uniform(),
+    "glorot_normal": jax.nn.initializers.glorot_normal(),
+    "he_uniform": jax.nn.initializers.he_uniform(),
+    "he_normal": jax.nn.initializers.he_normal(),
+    "lecun_uniform": jax.nn.initializers.lecun_uniform(),
+    "lecun_normal": jax.nn.initializers.lecun_normal(),
+    "zeros": jax.nn.initializers.zeros,
+    "ones": jax.nn.initializers.ones,
+    "uniform": jax.nn.initializers.uniform(0.05),
+    "normal": jax.nn.initializers.normal(0.05),
+    "orthogonal": jax.nn.initializers.orthogonal(),
+}
+
+
+def get(init: Union[str, Callable]) -> Callable:
+    if callable(init):
+        return init
+    try:
+        return INITIALIZERS[init]
+    except KeyError:
+        raise ValueError(f"unknown initializer {init!r}; known: "
+                         f"{sorted(INITIALIZERS)}") from None

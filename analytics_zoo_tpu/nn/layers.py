@@ -1,0 +1,361 @@
+"""Core + convolution + normalization layers (Keras-style, TPU-native).
+
+Reference (SURVEY.md §2.3): the Keras-1.2 layer zoo in
+zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/layers/ with
+py4j mirrors in pyzoo/zoo/pipeline/api/keras/layers/.  Scoped here to the
+subset used by zoo.models + the BASELINE configs (SURVEY.md §7 "Keras-1.2 API
+breadth"), with TPU-idiomatic choices:
+
+- NHWC image layout (TPU conv layout; the reference used NCHW for MKL-DNN),
+- optional bfloat16 compute dtype on matmul/conv (MXU native) with float32
+  params and accumulation,
+- everything jit/vmap/shard_map-composable (pure functions of variables).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import activations, initializers
+from .module import Module, Scope
+
+
+def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)  # type: ignore
+
+
+def _cast_for_compute(x: jax.Array, dtype: Optional[Any]) -> jax.Array:
+    return x.astype(dtype) if dtype is not None else x
+
+
+class Dense(Module):
+    """Fully connected layer (reference: keras/layers Dense)."""
+
+    def __init__(self, units: int, activation: Any = None, use_bias: bool = True,
+                 kernel_init: Any = "glorot_uniform", bias_init: Any = "zeros",
+                 dtype: Optional[Any] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.units = units
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+        self.bias_init = initializers.get(bias_init)
+        self.dtype = dtype
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        w = scope.param("kernel", self.kernel_init, (x.shape[-1], self.units))
+        y = jnp.dot(_cast_for_compute(x, self.dtype),
+                    _cast_for_compute(w, self.dtype),
+                    preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype) if x.dtype != y.dtype else y
+        if self.use_bias:
+            b = scope.param("bias", self.bias_init, (self.units,))
+            y = y + b
+        return self.activation(y)
+
+
+class Embedding(Module):
+    """Token embedding (reference: keras/layers Embedding)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 embeddings_init: Any = "normal", name: Optional[str] = None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.embeddings_init = initializers.get(embeddings_init)
+
+    def forward(self, scope: Scope, ids: jax.Array) -> jax.Array:
+        table = scope.param("embeddings", self.embeddings_init,
+                            (self.input_dim, self.output_dim))
+        return jnp.take(table, ids, axis=0)
+
+
+class Dropout(Module):
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = float(rate)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        if not scope.training or self.rate <= 0.0:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(scope.make_rng(), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Flatten(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.reshape(x.shape[0], -1)
+
+
+class Reshape(Module):
+    def __init__(self, target_shape: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+
+class Activation(Module):
+    def __init__(self, activation: Any, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = activations.get(activation)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return self.fn(x)
+
+
+class Lambda(Module):
+    """Wrap an arbitrary pure function as a layer (reference: autograd Lambda,
+    pyzoo/zoo/pipeline/api/autograd.py)."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = fn
+
+    def forward(self, scope: Scope, *args: Any) -> Any:
+        return self.fn(*args)
+
+
+# -- convolution / pooling (NHWC) ---------------------------------------------
+
+class Conv2D(Module):
+    """2-D convolution, NHWC/HWIO (reference: keras/layers Convolution2D —
+    which was NCHW for MKL-DNN; NHWC is the TPU-native layout)."""
+
+    def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: str = "same", activation: Any = None,
+                 use_bias: bool = True, kernel_init: Any = "he_normal",
+                 dilation: Union[int, Sequence[int]] = 1,
+                 groups: int = 1, dtype: Optional[Any] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.upper()
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+        self.dilation = _pair(dilation)
+        self.groups = groups
+        self.dtype = dtype
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        in_ch = x.shape[-1]
+        w = scope.param("kernel", self.kernel_init,
+                        (kh, kw, in_ch // self.groups, self.filters))
+        y = jax.lax.conv_general_dilated(
+            _cast_for_compute(x, self.dtype), _cast_for_compute(w, self.dtype),
+            window_strides=self.strides, padding=self.padding,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype) if x.dtype != y.dtype else y
+        if self.use_bias:
+            b = scope.param("bias", initializers.get("zeros"), (self.filters,))
+            y = y + b
+        return self.activation(y)
+
+
+class Conv1D(Module):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "same", activation: Any = None,
+                 use_bias: bool = True, kernel_init: Any = "he_normal",
+                 dilation: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.conv = Conv2D(filters, (1, kernel_size), (1, strides), padding,
+                           activation, use_bias, kernel_init, (1, dilation),
+                           name="conv2d")
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        y = scope.child(self.conv, x[:, None, :, :], name="conv")
+        return y[:, 0]
+
+
+def _pool(x: jax.Array, kind: str, window: Tuple[int, int],
+          strides: Tuple[int, int], padding: str) -> jax.Array:
+    dims = (1, window[0], window[1], 1)
+    strd = (1, strides[0], strides[1], 1)
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strd,
+                                     padding)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, padding)
+    if padding == "VALID":
+        return s / (window[0] * window[1])
+    ones = jnp.ones(x.shape[:1] + x.shape[1:3] + (1,), x.dtype)
+    cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd, padding)
+    return s / cnt
+
+
+class MaxPooling2D(Module):
+    def __init__(self, pool_size: Union[int, Sequence[int]] = 2,
+                 strides: Optional[Union[int, Sequence[int]]] = None,
+                 padding: str = "valid", name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding.upper()
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return _pool(x, "max", self.pool_size, self.strides, self.padding)
+
+
+class AveragePooling2D(Module):
+    def __init__(self, pool_size: Union[int, Sequence[int]] = 2,
+                 strides: Optional[Union[int, Sequence[int]]] = None,
+                 padding: str = "valid", name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding.upper()
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return _pool(x, "avg", self.pool_size, self.strides, self.padding)
+
+
+class GlobalAveragePooling2D(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.mean(axis=(1, 2))
+
+
+class GlobalMaxPooling2D(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.max(axis=(1, 2))
+
+
+class GlobalAveragePooling1D(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.mean(axis=1)
+
+
+class GlobalMaxPooling1D(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x.max(axis=1)
+
+
+class ZeroPadding2D(Module):
+    def __init__(self, padding: Union[int, Sequence[int]] = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.padding = _pair(padding)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        ph, pw = self.padding
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+# -- normalization -------------------------------------------------------------
+
+class BatchNormalization(Module):
+    """Batch norm with running statistics carried in the state collection
+    (reference: keras/layers BatchNormalization; BigDL mutated them in-place,
+    here apply() returns the updated state)."""
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 center: bool = True, scale: bool = True,
+                 axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+        self.axis = axis
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        dim = x.shape[self.axis]
+        reduce_axes = tuple(i for i in range(x.ndim)
+                            if i != (self.axis % x.ndim))
+        mean_run = scope.variable("mean", lambda: jnp.zeros((dim,)))
+        var_run = scope.variable("var", lambda: jnp.ones((dim,)))
+        if scope.training:
+            mean = x.mean(axis=reduce_axes)
+            var = x.var(axis=reduce_axes)
+            m = self.momentum
+            scope.put_variable("mean", m * mean_run + (1 - m) * mean)
+            scope.put_variable("var", m * var_run + (1 - m) * var)
+        else:
+            mean, var = mean_run, var_run
+        shape = [1] * x.ndim
+        shape[self.axis] = dim
+        y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + self.epsilon)
+        if self.scale:
+            y = y * scope.param("gamma", initializers.get("ones"), (dim,)
+                                ).reshape(shape)
+        if self.center:
+            y = y + scope.param("beta", initializers.get("zeros"), (dim,)
+                                ).reshape(shape)
+        return y
+
+
+class LayerNormalization(Module):
+    def __init__(self, epsilon: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        mean = x.mean(axis=-1, keepdims=True)
+        var = jnp.square(x - mean).mean(axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        g = scope.param("gamma", initializers.get("ones"), (dim,))
+        b = scope.param("beta", initializers.get("zeros"), (dim,))
+        return y * g + b
+
+
+# -- merge layers (reference: keras merge.Concat/Add/Mul) ----------------------
+
+class Concatenate(Module):
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, scope: Scope, xs: Sequence[jax.Array]) -> jax.Array:
+        return jnp.concatenate(list(xs), axis=self.axis)
+
+
+class Add(Module):
+    def forward(self, scope: Scope, xs: Sequence[jax.Array]) -> jax.Array:
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+
+class Multiply(Module):
+    def forward(self, scope: Scope, xs: Sequence[jax.Array]) -> jax.Array:
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out
+
+
+# -- containers ----------------------------------------------------------------
+
+class Sequential(Module):
+    """Linear stack of layers (reference: keras/models Sequential)."""
+
+    def __init__(self, layers: Optional[Sequence[Module]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.layers = list(layers or [])
+
+    def add(self, layer: Module) -> "Sequential":
+        self.layers.append(layer)
+        return self
+
+    def forward(self, scope: Scope, x: Any, **kwargs: Any) -> Any:
+        for i, layer in enumerate(self.layers):
+            base = layer.name or f"layer{i}"
+            x = scope.child(layer, x, name=f"{i:02d}_{base}"
+                            if layer.name is None else layer.name)
+        return x

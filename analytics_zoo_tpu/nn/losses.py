@@ -1,0 +1,101 @@
+"""Loss functions (reference: Keras-zoo objectives,
+zoo/.../pipeline/api/keras/objectives/ — SparseCategoricalCrossEntropy,
+CategoricalCrossEntropy, BinaryCrossEntropy, MSE/MAE, Hinge, …).
+
+Every loss is ``fn(y_pred, y_true) -> scalar`` (mean over the batch), pure
+and jit-safe.  ``get`` resolves Keras-style string names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def sparse_categorical_crossentropy(y_pred: jax.Array, y_true: jax.Array,
+                                    from_logits: bool = True) -> jax.Array:
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
+    y_true = y_true.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, y_true[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def categorical_crossentropy(y_pred: jax.Array, y_true: jax.Array,
+                             from_logits: bool = True) -> jax.Array:
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
+    return -(y_true * logp).sum(axis=-1).mean()
+
+
+def binary_crossentropy(y_pred: jax.Array, y_true: jax.Array,
+                        from_logits: bool = True) -> jax.Array:
+    y_true = y_true.astype(y_pred.dtype)
+    if from_logits:
+        # numerically stable log-sigmoid form
+        return jnp.mean(jnp.clip(y_pred, 0) - y_pred * y_true +
+                        jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+    p = jnp.clip(y_pred, 1e-7, 1 - 1e-7)
+    return -(y_true * jnp.log(p) + (1 - y_true) * jnp.log(1 - p)).mean()
+
+
+def mean_squared_error(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    return jnp.square(y_pred - y_true).mean()
+
+
+def mean_absolute_error(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    return jnp.abs(y_pred - y_true).mean()
+
+
+def huber(y_pred: jax.Array, y_true: jax.Array, delta: float = 1.0
+          ) -> jax.Array:
+    err = jnp.abs(y_pred - y_true)
+    quad = jnp.minimum(err, delta)
+    return (0.5 * quad**2 + delta * (err - quad)).mean()
+
+
+def hinge(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    return jnp.maximum(0.0, 1.0 - y_true * y_pred).mean()
+
+
+def kld(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    p = jnp.clip(y_true, 1e-7, 1.0)
+    q = jnp.clip(y_pred, 1e-7, 1.0)
+    return (p * jnp.log(p / q)).sum(axis=-1).mean()
+
+
+def cosine_proximity(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    yp = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + 1e-8)
+    yt = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + 1e-8)
+    return -(yp * yt).sum(axis=-1).mean()
+
+
+LOSSES = {
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "huber": huber,
+    "hinge": hinge,
+    "kld": kld,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get(loss: Union[str, Callable]) -> Callable:
+    if callable(loss):
+        return loss
+    try:
+        return LOSSES[loss]
+    except KeyError:
+        raise ValueError(
+            f"unknown loss {loss!r}; known: {sorted(LOSSES)}") from None

@@ -1,0 +1,167 @@
+"""Minimal functional module system: the base of the Keras-style layer API.
+
+Reference (SURVEY.md §2.3): the Keras-1.2-style API was ~25k LoC of Scala
+layers over BigDL's imperative module graph (zoo/src/main/scala/com/intel/
+analytics/zoo/pipeline/api/keras/) plus 10k LoC of py4j mirrors
+(pyzoo/zoo/pipeline/api/keras/).  Layers held mutable weights; training
+mutated them in place inside the JVM.
+
+TPU-native redesign: layers are *pure functions* of an explicit variables
+pytree, the form XLA wants — ``init`` builds {"params", "state"} by tracing
+the layer once over example inputs; ``apply`` is referentially transparent
+(jit/grad/vmap/shard_map compose over it).  A small ``Scope`` object threads
+parameter creation, RNG splitting, and BatchNorm-style mutable state through
+nested submodules, so layer code reads like Keras but compiles like JAX.
+
+No flax dependency: the whole mechanism is this file.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+class Scope:
+    """Threads variable access through one ``init`` or ``apply`` trace."""
+
+    def __init__(self, params: Params, state: Params, rng: Optional[jax.Array],
+                 training: bool, init_mode: bool, path: Tuple[str, ...] = ()):
+        self.params = params
+        self.state = state
+        self.rng = rng
+        self.training = training
+        self.init_mode = init_mode
+        self.path = path
+        self._rng_count = 0
+        self._child_counts: Dict[str, int] = {}
+
+    # -- variables ------------------------------------------------------------
+
+    def param(self, name: str, initializer: Callable, shape: Sequence[int],
+              dtype: Any = jnp.float32) -> jax.Array:
+        if self.init_mode:
+            if name in self.params:
+                raise ValueError(f"duplicate param {name!r} at {self.path}")
+            self.params[name] = initializer(self.make_rng(), tuple(shape), dtype)
+        if name not in self.params:
+            raise KeyError(f"missing param {name!r} at {'/'.join(self.path)}")
+        return self.params[name]
+
+    def variable(self, name: str, init_fn: Callable[[], jax.Array]) -> jax.Array:
+        """Non-trainable state (e.g. BatchNorm running stats)."""
+        if self.init_mode and name not in self.state:
+            self.state[name] = init_fn()
+        return self.state[name]
+
+    def put_variable(self, name: str, value: jax.Array) -> None:
+        """Record a state update (visible in the new_state returned by apply).
+        No-op during init: init captures initial values, not updates."""
+        if not self.init_mode:
+            self.state[name] = value
+
+    # -- rng ------------------------------------------------------------------
+
+    def make_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise ValueError(
+                f"layer at {'/'.join(self.path)} needs an rng (pass rng= to "
+                "init/apply, required for dropout in training mode)")
+        self._rng_count += 1
+        return jax.random.fold_in(self.rng, self._rng_count)
+
+    # -- submodules -----------------------------------------------------------
+
+    def child(self, module: "Module", *args: Any, name: Optional[str] = None,
+              **kwargs: Any) -> Any:
+        """Run a submodule under a nested scope."""
+        if name is None:
+            base = module.name or _snake(type(module).__name__)
+            idx = self._child_counts.get(base, 0)
+            self._child_counts[base] = idx + 1
+            name = base if idx == 0 else f"{base}_{idx}"
+        sub_params = self.params.setdefault(name, {}) if self.init_mode else \
+            self.params.get(name, {})
+        sub_state_in = self.state.get(name, {})
+        sub_state = dict(sub_state_in) if not self.init_mode else \
+            self.state.setdefault(name, {})
+        # zlib.crc32 (not hash()): stable across processes so every SPMD host
+        # derives identical init RNGs for identically-named layers.
+        sub = Scope(sub_params, sub_state,
+                    jax.random.fold_in(self.rng, zlib.crc32(name.encode()))
+                    if self.rng is not None else None,
+                    self.training, self.init_mode, self.path + (name,))
+        out = module.forward(sub, *args, **kwargs)
+        if not self.init_mode and (sub.state or sub_state_in):
+            self.state[name] = sub.state
+        return out
+
+
+class Module:
+    """Base class for all layers.  Subclasses implement ``forward(scope, ...)``."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+
+    def forward(self, scope: Scope, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError
+
+    # -- public API -----------------------------------------------------------
+
+    def init(self, rng: jax.Array, *args: Any, training: bool = False,
+             **kwargs: Any) -> Params:
+        """Trace once over example inputs; returns {"params", "state"}."""
+        args = tuple(_as_jax(a) for a in args)
+        scope = Scope({}, {}, rng, training, init_mode=True)
+        self.forward(scope, *args, **kwargs)
+        return {"params": scope.params, "state": scope.state}
+
+    def apply(self, variables: Params, *args: Any, training: bool = False,
+              rng: Optional[jax.Array] = None, **kwargs: Any
+              ) -> Tuple[Any, Params]:
+        """Pure application: returns (output, new_state)."""
+        state_in = variables.get("state", {})
+        scope = Scope(variables.get("params", {}), dict(state_in), rng,
+                      training, init_mode=False)
+        out = self.forward(scope, *args, **kwargs)
+        return out, scope.state
+
+    def __call__(self, scope_or_vars: Any, *args: Any, **kwargs: Any) -> Any:
+        """Inside another module's forward: ``layer(scope, x)`` delegates via
+        the parent scope (auto-named child).  Outside: alias for apply."""
+        if isinstance(scope_or_vars, Scope):
+            return scope_or_vars.child(self, *args, **kwargs)
+        return self.apply(scope_or_vars, *args, **kwargs)
+
+    # convenience
+    def init_apply(self, rng: jax.Array, *args: Any, **kwargs: Any
+                   ) -> Tuple[Params, Any]:
+        variables = self.init(rng, *args, **kwargs)
+        out, _ = self.apply(variables, *args, **kwargs)
+        return variables, out
+
+
+def _snake(s: str) -> str:
+    out = []
+    for i, c in enumerate(s):
+        if c.isupper() and i and (not s[i - 1].isupper()):
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
+def _as_jax(a: Any) -> Any:
+    if isinstance(a, (np.ndarray, np.generic, float, int)):
+        return jnp.asarray(a)
+    return a
+
+
+def param_count(variables: Params) -> int:
+    leaves = jax.tree_util.tree_leaves(variables.get("params", variables))
+    return sum(int(np.prod(l.shape)) for l in leaves if hasattr(l, "shape"))

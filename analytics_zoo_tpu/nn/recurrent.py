@@ -1,0 +1,160 @@
+"""Recurrent layers: LSTM/GRU/SimpleRNN + Bidirectional/TimeDistributed.
+
+Reference (SURVEY.md §2.3): keras/layers recurrent classes in the Scala zoo
+(LSTM, GRU, SimpleRNN, Bidirectional, TimeDistributed) executed step-by-step
+on BigDL's CPU engine.  TPU-native: the time loop is a single ``lax.scan`` —
+compiled control flow, no Python loop, weights fetched once; the gate matmuls
+are fused into one [F, 4U] product per step (MXU-friendly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import activations, initializers
+from .module import Module, Scope
+
+
+class _RNNBase(Module):
+    def __init__(self, units: int, return_sequences: bool = False,
+                 return_state: bool = False, go_backwards: bool = False,
+                 kernel_init: Any = "glorot_uniform",
+                 recurrent_init: Any = "orthogonal",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.units = units
+        self.return_sequences = return_sequences
+        self.return_state = return_state
+        self.go_backwards = go_backwards
+        self.kernel_init = initializers.get(kernel_init)
+        self.recurrent_init = initializers.get(recurrent_init)
+
+    n_gates = 1
+
+    def _weights(self, scope: Scope, in_dim: int):
+        u, g = self.units, self.n_gates
+        wi = scope.param("kernel", self.kernel_init, (in_dim, g * u))
+        wh = scope.param("recurrent_kernel", self.recurrent_init, (u, g * u))
+        b = scope.param("bias", initializers.get("zeros"), (g * u,))
+        return wi, wh, b
+
+    def _init_carry(self, batch: int) -> Any:
+        raise NotImplementedError
+
+    def _step(self, weights, carry, x_t):
+        raise NotImplementedError
+
+    def forward(self, scope: Scope, x: jax.Array):
+        weights = self._weights(scope, x.shape[-1])
+        carry0 = self._init_carry(x.shape[0])
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, F] for scan
+        if self.go_backwards:
+            xs = xs[::-1]
+
+        def step(carry, x_t):
+            carry, out = self._step(weights, carry, x_t)
+            return carry, out
+
+        carry, outs = jax.lax.scan(step, carry0, xs)
+        if self.go_backwards:
+            outs = outs[::-1]
+        seq = jnp.swapaxes(outs, 0, 1)  # [B, T, U]
+        out = seq if self.return_sequences else seq[:, -1]
+        if self.return_state:
+            return out, carry
+        return out
+
+
+class LSTM(_RNNBase):
+    n_gates = 4
+
+    def _init_carry(self, batch: int):
+        z = jnp.zeros((batch, self.units))
+        return (z, z)  # (h, c)
+
+    def _step(self, weights, carry, x_t):
+        wi, wh, b = weights
+        h, c = carry
+        z = x_t @ wi + h @ wh + b
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f + 1.0), jax.nn.sigmoid(o)
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+
+class GRU(_RNNBase):
+    n_gates = 3
+
+    def _init_carry(self, batch: int):
+        return jnp.zeros((batch, self.units))
+
+    def _step(self, weights, carry, x_t):
+        wi, wh, b = weights
+        h = carry
+        xz = x_t @ wi + b
+        hz = h @ wh
+        u = self.units
+        r = jax.nn.sigmoid(xz[:, :u] + hz[:, :u])
+        z = jax.nn.sigmoid(xz[:, u:2 * u] + hz[:, u:2 * u])
+        n = jnp.tanh(xz[:, 2 * u:] + r * hz[:, 2 * u:])
+        h = (1 - z) * n + z * h
+        return h, h
+
+
+class SimpleRNN(_RNNBase):
+    n_gates = 1
+
+    def _init_carry(self, batch: int):
+        return jnp.zeros((batch, self.units))
+
+    def _step(self, weights, carry, x_t):
+        wi, wh, b = weights
+        h = jnp.tanh(x_t @ wi + carry @ wh + b)
+        return h, h
+
+
+class Bidirectional(Module):
+    """Run a recurrent layer forward and backward, merge outputs
+    (reference: keras/layers Bidirectional; merge modes concat/sum/mul/ave)."""
+
+    def __init__(self, layer: _RNNBase, merge_mode: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        import copy
+        self.fwd = layer
+        self.bwd = copy.copy(layer)
+        self.bwd.go_backwards = not layer.go_backwards
+        self.merge_mode = merge_mode
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        yf = scope.child(self.fwd, x, name="forward")
+        yb = scope.child(self.bwd, x, name="backward")
+        if self.merge_mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        if self.merge_mode == "sum":
+            return yf + yb
+        if self.merge_mode == "mul":
+            return yf * yb
+        if self.merge_mode == "ave":
+            return (yf + yb) / 2
+        raise ValueError(f"unknown merge_mode {self.merge_mode!r}")
+
+
+class TimeDistributed(Module):
+    """Apply a layer independently to every timestep via vmap
+    (reference: keras/layers TimeDistributed — a Python loop there; one
+    batched trace here)."""
+
+    def __init__(self, layer: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.layer = layer
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        b, t = x.shape[:2]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y = scope.child(self.layer, flat, name="inner")
+        return y.reshape((b, t) + y.shape[1:])

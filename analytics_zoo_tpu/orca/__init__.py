@@ -1,0 +1,11 @@
+"""Orca: unified distributed training/inference API (reference L6/L7).
+
+Reference-parity imports:
+    from analytics_zoo_tpu.orca import init_orca_context, OrcaContext
+    from analytics_zoo_tpu.orca.learn import Estimator
+"""
+
+from analytics_zoo_tpu.core import (OrcaContext, init_orca_context,
+                                    stop_orca_context)
+
+__all__ = ["OrcaContext", "init_orca_context", "stop_orca_context"]

@@ -1,0 +1,8 @@
+"""Orca learn: the unified Estimator layer (reference L6, SURVEY.md §2.4)."""
+
+from .estimator import Estimator, ZooEstimator
+from .trigger import EveryEpoch, SeveralIteration, Trigger
+from . import optimizers
+
+__all__ = ["Estimator", "ZooEstimator", "EveryEpoch", "SeveralIteration",
+           "Trigger", "optimizers"]

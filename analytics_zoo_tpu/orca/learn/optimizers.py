@@ -1,0 +1,48 @@
+"""Optimizer resolution (reference: Orca optimizer wrappers,
+pyzoo/zoo/orca/learn/optimizers.py — SGD/Adam/AdamW/RMSprop etc. mapped onto
+BigDL OptimMethods).  Here they map onto optax gradient transformations."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import optax
+
+_FACTORIES = {
+    "sgd": lambda lr, **kw: optax.sgd(lr, **kw),
+    "momentum": lambda lr, **kw: optax.sgd(lr, momentum=kw.pop("momentum", 0.9),
+                                           **kw),
+    "adam": lambda lr, **kw: optax.adam(lr, **kw),
+    "adamw": lambda lr, **kw: optax.adamw(lr, **kw),
+    "rmsprop": lambda lr, **kw: optax.rmsprop(lr, **kw),
+    "adagrad": lambda lr, **kw: optax.adagrad(lr, **kw),
+    "lamb": lambda lr, **kw: optax.lamb(lr, **kw),
+    "lars": lambda lr, **kw: optax.lars(lr, **kw),
+}
+
+
+def get(optimizer: Union[str, optax.GradientTransformation, None],
+        learning_rate: Optional[Any] = None,
+        grad_clip_norm: Optional[float] = None,
+        **kwargs: Any) -> optax.GradientTransformation:
+    """Resolve an optimizer spec to an optax transformation.
+
+    ``optimizer`` may be an optax transformation (used as-is), a name string,
+    or None (adam).  ``grad_clip_norm`` wraps with global-norm clipping —
+    parity with the reference's ``set_gradient_clipping``
+    (zoo/.../pipeline/api/keras/models/Topology.scala).
+    """
+    if optimizer is None:
+        optimizer = "adam"
+    if isinstance(optimizer, str):
+        name = optimizer.lower()
+        if name not in _FACTORIES:
+            raise ValueError(f"unknown optimizer {optimizer!r}; known: "
+                             f"{sorted(_FACTORIES)}")
+        tx = _FACTORIES[name](learning_rate if learning_rate is not None
+                              else 1e-3, **kwargs)
+    else:
+        tx = optimizer
+    if grad_clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx

@@ -40,9 +40,16 @@ def test_mesh_auto_axis():
 
 def test_mesh_bad_shape_raises():
     with pytest.raises(ValueError):
-        make_mesh({"data": 3})  # 3 does not divide 8
+        make_mesh({"data": 16})  # more than the 8 available
+    with pytest.raises(ValueError):
+        make_mesh({"data": 0, "model": 3})  # 3 does not divide 8
     with pytest.raises(ValueError):
         MeshConfig(data=0, model=0).resolved(8)  # two wildcards
+
+
+def test_mesh_subset_of_devices():
+    mesh = make_mesh({"data": 2})  # debugging subset on an 8-device host
+    assert mesh.devices.size == 2
 
 
 def test_get_mesh_autoinit():

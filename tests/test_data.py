@@ -1,0 +1,141 @@
+"""Data layer tests: XShards ops, readers, DataFeed sharding."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+from analytics_zoo_tpu.data import (DataFeed, XShards, as_feed, read_csv,
+                                    read_json, read_npz, shard_batch)
+
+
+def _df(n=20, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"a": rng.normal(size=n), "b": rng.integers(0, 5, n),
+                         "y": rng.integers(0, 2, n)})
+
+
+class TestXShards:
+    def test_partition_array(self):
+        s = XShards.partition(np.arange(10), num_shards=3)
+        assert s.num_partitions() == 3
+        np.testing.assert_array_equal(s.concatenated(), np.arange(10))
+
+    def test_partition_dict(self):
+        s = XShards.partition({"x": np.ones((10, 2)), "y": np.zeros(10)}, 4)
+        assert s.num_partitions() == 4
+        assert len(s) == 10
+        out = s.concatenated()
+        assert out["x"].shape == (10, 2)
+
+    def test_transform_shard(self):
+        s = XShards.partition(np.arange(10), 2).transform_shard(lambda a: a * 2)
+        np.testing.assert_array_equal(s.concatenated(), np.arange(10) * 2)
+
+    def test_transform_with_args(self):
+        s = XShards.partition(np.arange(4), 2).transform_shard(
+            lambda a, k: a + k, 5)
+        np.testing.assert_array_equal(s.concatenated(), np.arange(4) + 5)
+
+    def test_repartition_pandas(self):
+        s = XShards([_df(10), _df(10, 1)])
+        r = s.repartition(5)
+        assert r.num_partitions() == 5
+        assert sum(len(d) for d in r.collect()) == 20
+
+    def test_partition_by(self):
+        s = XShards([_df(50)])
+        parts = s.partition_by("b", num_partitions=3)
+        assert parts.num_partitions() == 3
+        seen = {}
+        for i, df in enumerate(parts.collect()):
+            for v in df["b"].unique():
+                assert v not in seen, "key split across partitions"
+                seen[v] = i
+
+    def test_split(self):
+        s = XShards([(np.ones(3), np.zeros(3)), (np.ones(2), np.zeros(2))])
+        xs, ys = s.split()
+        assert len(xs) == 5 and len(ys) == 5
+
+    def test_to_numpy_dict(self):
+        s = XShards([_df(10)]).to_numpy_dict(feature_cols=["a", "b"],
+                                             label_cols=["y"])
+        d = s.collect()[0]
+        assert d["x"].shape == (10, 2) and d["y"].shape == (10,)
+
+
+class TestReaders:
+    def test_read_csv_glob(self, tmp_path):
+        for i in range(3):
+            _df(10, i).to_csv(tmp_path / f"part{i}.csv", index=False)
+        s = read_csv(str(tmp_path / "*.csv"))
+        assert s.num_partitions() == 3
+        assert len(s) == 30
+
+    def test_read_csv_dir_and_repartition(self, tmp_path):
+        for i in range(4):
+            _df(5, i).to_csv(tmp_path / f"p{i}.csv", index=False)
+        s = read_csv(str(tmp_path), num_shards=2)
+        assert s.num_partitions() == 2
+        assert len(s) == 20
+
+    def test_read_json(self, tmp_path):
+        _df(8).to_json(tmp_path / "d.json", orient="records")
+        s = read_json(str(tmp_path / "d.json"))
+        assert len(s) == 8
+
+    def test_read_npz(self, tmp_path):
+        np.savez(tmp_path / "d.npz", x=np.ones((6, 2)), y=np.zeros(6))
+        s = read_npz(str(tmp_path / "d.npz"))
+        assert s.collect()[0]["x"].shape == (6, 2)
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_csv(str(tmp_path / "none*.csv"))
+
+
+class TestDataFeed:
+    def test_batches_are_sharded(self):
+        mesh = init_orca_context("local")
+        feed = DataFeed.from_arrays(np.ones((64, 4), np.float32),
+                                    np.zeros(64, np.int32), batch_size=16)
+        batches = list(feed.epoch(mesh, 0))
+        assert len(batches) == 4
+        b = batches[0]
+        assert b["x"].shape == (16, 4)
+        assert b["x"].sharding.is_fully_replicated is False
+        # dim 0 split over the 8-device data axis
+        assert b["x"].addressable_shards[0].data.shape == (2, 4)
+
+    def test_shuffle_deterministic(self):
+        mesh = init_orca_context("local")
+        feed = DataFeed.from_arrays(np.arange(32, dtype=np.float32),
+                                    batch_size=8, shuffle=True, seed=3)
+        e1 = [np.asarray(b["x"]) for b in feed.epoch(mesh, 0)]
+        e2 = [np.asarray(b["x"]) for b in feed.epoch(mesh, 0)]
+        e3 = [np.asarray(b["x"]) for b in feed.epoch(mesh, 1)]
+        np.testing.assert_array_equal(np.concatenate(e1), np.concatenate(e2))
+        assert not np.array_equal(np.concatenate(e1), np.concatenate(e3))
+
+    def test_row_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            DataFeed({"x": np.ones(10), "y": np.ones(9)}, 2)
+
+    def test_as_feed_forms(self):
+        f1 = as_feed((np.ones(8), np.ones(8)), 4)
+        f2 = as_feed({"x": np.ones(8)}, 4)
+        f3 = as_feed(XShards.partition({"x": np.ones(8)}, 2), 4)
+        assert f1.num_rows == f2.num_rows == f3.num_rows == 8
+        assert as_feed(f1, 4) is f1
+
+    def test_shard_batch_tree(self):
+        mesh = init_orca_context("local")
+        out = shard_batch({"x": np.ones((8, 3)), "y": np.ones(8)}, mesh)
+        assert out["x"].shape == (8, 3) and out["y"].shape == (8,)
+
+    def test_empty_batch_raises(self):
+        mesh = init_orca_context("local")
+        feed = DataFeed.from_arrays(np.ones((2, 2)), batch_size=8)
+        with pytest.raises(ValueError):
+            next(feed.epoch(mesh))

@@ -1,0 +1,167 @@
+"""End-to-end Estimator tests: the SURVEY.md §7 stage-3 milestone.
+
+Covers: fit reduces loss (LeNet/MNIST-like), metrics, predict exactness,
+save/load round-trip, XShards + DataFrame column paths, and the golden
+data-parallel consistency check (§7 stage 4): same data+seed ⇒ same result
+regardless of mesh layout, because the global batch is what defines the step.
+"""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.core import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.data import XShards
+from analytics_zoo_tpu.orca.learn import Estimator, EveryEpoch
+
+
+def make_blobs(n=256, dim=8, classes=4, seed=0):
+    """Linearly separable clusters — tiny stand-in for MNIST."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, dim)) * 3
+    y = rng.integers(0, classes, n)
+    x = centers[y] + rng.normal(size=(n, dim)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def mlp(classes=4):
+    return nn.Sequential([
+        nn.Dense(32, activation="relu"),
+        nn.Dense(classes),
+    ])
+
+
+def test_fit_reduces_loss_and_learns():
+    init_orca_context("local")
+    x, y = make_blobs()
+    est = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy",
+                               optimizer="adam", learning_rate=1e-2,
+                               metrics=["accuracy"])
+    hist = est.fit((x, y), epochs=5, batch_size=64)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5
+    res = est.evaluate((x, y), batch_size=64)
+    assert res["accuracy"] > 0.9
+
+
+def test_lenet_mnist_smoke():
+    """LeNet on synthetic digits: the BASELINE LeNet/MNIST config at toy scale."""
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+    model = nn.Sequential([
+        nn.Conv2D(6, 5, activation="relu"), nn.MaxPooling2D(2),
+        nn.Conv2D(16, 5, padding="valid", activation="relu"),
+        nn.MaxPooling2D(2), nn.Flatten(),
+        nn.Dense(120, activation="relu"), nn.Dense(84, activation="relu"),
+        nn.Dense(10),
+    ])
+    est = Estimator.from_keras(model, loss="sparse_categorical_crossentropy",
+                               learning_rate=5e-3)
+    hist = est.fit((x, y), epochs=3, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0]  # memorizing noise: loss drops
+    preds = est.predict(x, batch_size=32)
+    assert preds.shape == (64, 10)
+
+
+def test_predict_exact_rows_with_remainder():
+    init_orca_context("local")
+    x, y = make_blobs(n=70)  # not divisible by batch or 8 devices
+    est = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy")
+    est.fit((x, y), epochs=1, batch_size=32)
+    preds = est.predict(x, batch_size=32)
+    assert preds.shape[0] == 70
+
+
+def test_save_load_roundtrip(tmp_path):
+    init_orca_context("local")
+    x, y = make_blobs()
+    est = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2)
+    est.fit((x, y), epochs=2, batch_size=64)
+    p1 = est.predict(x)
+    est.save(str(tmp_path / "m"))
+
+    est2 = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy",
+                                learning_rate=1e-2)
+    est2.load(str(tmp_path / "m"))
+    p2 = est2.predict(x)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+    # resumed training continues from the same step count
+    assert int(est2._ts["step"]) == int(est._ts["step"])
+
+
+def test_checkpoint_trigger_writes(tmp_path):
+    init_orca_context("local")
+    x, y = make_blobs(n=128)
+    est = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy",
+                               model_dir=str(tmp_path / "ckpt"))
+    est.fit((x, y), epochs=1, batch_size=64, checkpoint_trigger=EveryEpoch())
+    from analytics_zoo_tpu.core import checkpoint as ck
+    assert ck.exists(str(tmp_path / "ckpt"))
+
+
+def test_fit_from_xshards_dataframe_cols():
+    import pandas as pd
+    init_orca_context("local")
+    x, y = make_blobs(n=120, dim=3)
+    df = pd.DataFrame({"f1": x[:, 0], "f2": x[:, 1], "f3": x[:, 2], "label": y})
+    shards = XShards([df.iloc[:60], df.iloc[60:]])
+    est = Estimator.from_keras(mlp(), loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2, metrics=["accuracy"])
+    est.fit(shards, epochs=3, batch_size=40,
+            feature_cols=["f1", "f2", "f3"], label_cols=["label"])
+    res = est.evaluate(shards, batch_size=40,
+                       feature_cols=["f1", "f2", "f3"], label_cols=["label"])
+    assert res["accuracy"] > 0.5
+
+
+def test_dp_consistency_across_mesh_layouts():
+    """Golden §7-stage-4 test: with identical global batches, training on a
+    1-wide vs 8-wide data axis gives the same params (psum == single-device
+    sum).  CPU f32 math is deterministic enough for a near-exact match."""
+    x, y = make_blobs(n=64, seed=3)
+
+    def run(mesh_shape):
+        stop_orca_context()
+        init_orca_context("local", mesh_shape=mesh_shape)
+        est = Estimator.from_keras(
+            mlp(), loss="sparse_categorical_crossentropy",
+            optimizer="sgd", learning_rate=0.1, seed=7)
+        est.fit((x, y), epochs=2, batch_size=32)
+        return est.predict(x)
+
+    p_wide = run({"data": 8})
+    p_one = run({"data": 1})
+    np.testing.assert_allclose(p_wide, p_one, rtol=2e-3, atol=2e-4)
+
+
+def test_batchnorm_model_trains():
+    """State (running stats) threads through fit and is used in eval."""
+    init_orca_context("local")
+    x, y = make_blobs(n=128)
+    model = nn.Sequential([nn.Dense(16), nn.BatchNormalization(),
+                           nn.Activation("relu"), nn.Dense(4)])
+    est = Estimator.from_keras(model, loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2)
+    est.fit((x, y), epochs=2, batch_size=64)
+    stats = est.get_model()["state"]
+    leaves = [np.asarray(v) for v in
+              __import__("jax").tree_util.tree_leaves(stats)]
+    assert any(np.abs(l).sum() > 0 for l in leaves)
+    preds = est.predict(x)
+    assert preds.shape == (128, 4)
+
+
+def test_evaluate_empty_raises():
+    init_orca_context("local")
+    est = Estimator.from_keras(mlp(), loss="mse")
+    with pytest.raises(ValueError):
+        est.evaluate((np.ones((2, 4), np.float32), np.ones(2)), batch_size=64)
+
+
+def test_save_uninitialized_raises(tmp_path):
+    init_orca_context("local")
+    est = Estimator.from_keras(mlp(), loss="mse")
+    with pytest.raises(ValueError):
+        est.save(str(tmp_path / "x"))

@@ -1,0 +1,259 @@
+"""NN layer tests: shapes, purity, state handling, differential goldens."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn as nn
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_dense_shapes_and_grad():
+    layer = nn.Dense(16, activation="relu")
+    x = jnp.ones((4, 8))
+    variables = layer.init(KEY, x)
+    y, _ = layer.apply(variables, x)
+    assert y.shape == (4, 16)
+    assert variables["params"]["kernel"].shape == (8, 16)
+
+    def loss(v):
+        out, _ = layer.apply(v, x)
+        return (out ** 2).mean()
+    g = jax.grad(loss)(variables)
+    assert g["params"]["kernel"].shape == (8, 16)
+    assert float(jnp.abs(g["params"]["kernel"]).sum()) > 0
+
+
+def test_dense_matches_numpy():
+    layer = nn.Dense(3, use_bias=True)
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    variables = layer.init(KEY, jnp.asarray(x))
+    w = np.asarray(variables["params"]["kernel"])
+    b = np.asarray(variables["params"]["bias"])
+    y, _ = layer.apply(variables, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), x @ w + b, rtol=1e-5)
+
+
+def test_sequential_lenet_forward():
+    model = nn.Sequential([
+        nn.Conv2D(6, 5, padding="same", activation="relu"),
+        nn.MaxPooling2D(2),
+        nn.Conv2D(16, 5, padding="valid", activation="relu"),
+        nn.MaxPooling2D(2),
+        nn.Flatten(),
+        nn.Dense(120, activation="relu"),
+        nn.Dense(84, activation="relu"),
+        nn.Dense(10),
+    ])
+    x = jnp.ones((2, 28, 28, 1))
+    variables, y = model.init_apply(KEY, x)
+    assert y.shape == (2, 10)
+    assert nn.param_count(variables) > 40000
+
+
+def test_conv2d_matches_known():
+    # 1x1 kernel conv == per-pixel dense
+    layer = nn.Conv2D(2, 1, use_bias=False)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1, 4, 4, 3)),
+                    jnp.float32)
+    variables = layer.init(KEY, x)
+    w = np.asarray(variables["params"]["kernel"])[0, 0]  # [3, 2]
+    y, _ = layer.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ w, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_pooling():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    ymax, _ = nn.MaxPooling2D(2).init_apply(KEY, x)[1], None
+    y, _ = nn.MaxPooling2D(2).apply({}, x)
+    np.testing.assert_array_equal(np.asarray(y)[0, :, :, 0],
+                                  [[5, 7], [13, 15]])
+    ya, _ = nn.AveragePooling2D(2).apply({}, x)
+    np.testing.assert_allclose(np.asarray(ya)[0, :, :, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_state_updates():
+    bn = nn.BatchNormalization(momentum=0.5)
+    x = jnp.asarray(np.random.default_rng(0).normal(3.0, 2.0, (64, 8)),
+                    jnp.float32)
+    variables = bn.init(KEY, x, training=True)
+    assert np.allclose(variables["state"]["mean"], 0.0)
+    y, new_state = bn.apply(variables, x, training=True)
+    # output normalized in training mode
+    assert abs(float(y.mean())) < 1e-4
+    # running stats moved toward batch stats
+    assert float(np.abs(new_state["mean"]).sum()) > 0.1
+    # eval mode uses running stats, returns unchanged state
+    variables2 = {"params": variables["params"], "state": new_state}
+    y2, state2 = bn.apply(variables2, x, training=False)
+    np.testing.assert_allclose(np.asarray(state2["mean"]),
+                               np.asarray(new_state["mean"]))
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = d.apply({}, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = d.apply({}, x, training=True, rng=KEY)
+    frac_zero = float((np.asarray(y_train) == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+    # needs rng in training mode
+    with pytest.raises(ValueError):
+        d.apply({}, x, training=True)
+
+
+def test_layernorm():
+    ln = nn.LayerNormalization()
+    x = jnp.asarray(np.random.default_rng(0).normal(5, 3, (4, 10)), jnp.float32)
+    variables = ln.init(KEY, x)
+    y, _ = ln.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y.mean(-1)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.std(-1)), 1.0, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = jnp.asarray([[1, 2], [3, 4]])
+    variables, y = emb.init_apply(KEY, ids)
+    assert y.shape == (2, 2, 4)
+    table = np.asarray(variables["params"]["embeddings"])
+    np.testing.assert_allclose(np.asarray(y)[0, 0], table[1])
+
+
+def test_lstm_shapes_and_determinism():
+    lstm = nn.LSTM(12, return_sequences=True)
+    x = jnp.ones((3, 7, 5))
+    variables, y = lstm.init_apply(KEY, x)
+    assert y.shape == (3, 7, 12)
+    last = nn.LSTM(12)
+    v2, y2 = last.init_apply(KEY, x)
+    assert y2.shape == (3, 12)
+    y2b, _ = last.apply(v2, x)
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(y2b))
+
+
+def test_gru_and_simplernn():
+    x = jnp.ones((2, 5, 3))
+    for cls in (nn.GRU, nn.SimpleRNN):
+        _, y = cls(6).init_apply(KEY, x)
+        assert y.shape == (2, 6)
+
+
+def test_lstm_gradient_flows_through_time():
+    lstm = nn.LSTM(4)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 3)),
+                    jnp.float32)
+    variables = lstm.init(KEY, x)
+
+    def loss(v, xx):
+        out, _ = lstm.apply(v, xx)
+        return (out ** 2).sum()
+    gx = jax.grad(loss, argnums=1)(variables, x)
+    # gradient reaches the first timestep
+    assert float(jnp.abs(gx[:, 0]).sum()) > 0
+
+
+def test_bidirectional_concat():
+    bi = nn.Bidirectional(nn.LSTM(5, return_sequences=True))
+    x = jnp.ones((2, 4, 3))
+    _, y = bi.init_apply(KEY, x)
+    assert y.shape == (2, 4, 10)
+
+
+def test_time_distributed():
+    td = nn.TimeDistributed(nn.Dense(7))
+    x = jnp.ones((2, 4, 3))
+    _, y = td.init_apply(KEY, x)
+    assert y.shape == (2, 4, 7)
+
+
+def test_mha_self_attention():
+    mha = nn.MultiHeadAttention(num_heads=4)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 16)),
+                    jnp.float32)
+    variables, y = mha.init_apply(KEY, x)
+    assert y.shape == (2, 6, 16)
+
+
+def test_mha_masking_blocks_future():
+    mha = nn.MultiHeadAttention(num_heads=2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 5, 8)), jnp.float32)
+    causal = jnp.tril(jnp.ones((1, 1, 5, 5)))
+    variables = mha.init(KEY, x, mask=causal)
+    y1, _ = mha.apply(variables, x, mask=causal)
+    # perturb the last token: outputs for earlier positions must not change
+    x2 = x.at[0, -1].add(10.0)
+    y2, _ = mha.apply(variables, x2, mask=causal)
+    np.testing.assert_allclose(np.asarray(y1)[0, :4], np.asarray(y2)[0, :4],
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(y1)[0, 4], np.asarray(y2)[0, 4])
+
+
+def test_transformer_layer():
+    block = nn.TransformerLayer(num_heads=4)
+    x = jnp.ones((2, 6, 32))
+    variables, y = block.init_apply(KEY, x)
+    assert y.shape == (2, 6, 32)
+
+
+def test_losses_golden():
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0]])
+    labels = jnp.asarray([0, 1])
+    val = float(nn.losses.sparse_categorical_crossentropy(logits, labels))
+    expected = -np.log(np.exp(2) / (np.exp(2) + 1))
+    np.testing.assert_allclose(val, expected, rtol=1e-5)
+
+    np.testing.assert_allclose(
+        float(nn.losses.mean_squared_error(jnp.asarray([1.0, 3.0]),
+                                           jnp.asarray([0.0, 0.0]))), 5.0)
+    # bce from logits matches explicit formula
+    lp = jnp.asarray([0.3, -1.2])
+    lt = jnp.asarray([1.0, 0.0])
+    p = 1 / (1 + np.exp(-np.asarray(lp)))
+    expected = -np.mean(np.asarray(lt) * np.log(p) +
+                        (1 - np.asarray(lt)) * np.log(1 - p))
+    np.testing.assert_allclose(
+        float(nn.losses.binary_crossentropy(lp, lt)), expected, rtol=1e-5)
+
+
+def test_metrics():
+    acc = nn.metrics.get("accuracy")
+    logits = jnp.asarray([[0.1, 0.9], [0.9, 0.1], [0.2, 0.8]])
+    labels = jnp.asarray([1, 0, 0])
+    stats = acc.update(logits, labels)
+    assert float(acc.result(stats)) == pytest.approx(2 / 3)
+
+    auc = nn.metrics.get("auc")
+    rng = np.random.default_rng(0)
+    scores = jnp.asarray(rng.normal(size=500) +
+                         2 * np.repeat([0, 1], 250).astype(np.float32))
+    labels = jnp.asarray(np.repeat([0, 1], 250))
+    val = float(auc.result(auc.update(scores, labels)))
+    assert 0.85 < val < 1.0
+
+
+def test_unknown_names_raise():
+    with pytest.raises(ValueError):
+        nn.activations.get("not_a_thing")
+    with pytest.raises(ValueError):
+        nn.losses.get("not_a_loss")
+    with pytest.raises(ValueError):
+        nn.metrics.get("not_a_metric")
+    with pytest.raises(ValueError):
+        nn.initializers.get("not_an_init")
+
+
+def test_apply_is_pure():
+    model = nn.Sequential([nn.Dense(4), nn.Dense(2)])
+    x = jnp.ones((2, 3))
+    variables = model.init(KEY, x)
+    before = jax.tree_util.tree_map(np.asarray, variables)
+    model.apply(variables, x)
+    after = jax.tree_util.tree_map(np.asarray, variables)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
