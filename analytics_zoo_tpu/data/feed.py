@@ -16,7 +16,7 @@ Static shapes: batches are fixed-size (remainder dropped or padded) so the
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -117,6 +117,15 @@ class DataFeed:
         if self.drop_remainder:
             return self._n // self._local_batch
         return -(-self._n // self._local_batch)
+
+    def remainder(self) -> Optional[Dict[str, np.ndarray]]:
+        """The tail rows a drop_remainder epoch skips (unshuffled order), or
+        None.  Used by Estimator.evaluate so metrics cover every row."""
+        r = self._n % self._local_batch
+        if r == 0:
+            return None
+        sel = np.arange(self._n - r, self._n)
+        return jax.tree_util.tree_map(lambda a: _take(a, sel), self._data)
 
     def epoch(self, mesh: Mesh, epoch_idx: int = 0
               ) -> Iterator[Dict[str, jax.Array]]:

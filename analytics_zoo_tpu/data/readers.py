@@ -36,24 +36,35 @@ def _expand(file_path: str, extensions: Sequence[str]) -> List[str]:
     return files
 
 
-def _my_files(files: List[str]) -> List[str]:
-    """This host's slice of the global file list (SPMD round-robin)."""
+def _my_files(files: List[str]) -> tuple:
+    """This host's slice of the global file list (SPMD round-robin).
+
+    Returns (my_files, row_slice): when there are fewer files than hosts,
+    every host reads the full file list and ``row_slice = (pid, n)`` tells the
+    reader to keep only rows ``pid::n`` — so the union over hosts is exactly
+    the dataset, no row duplicated."""
     pid, n = jax.process_index(), jax.process_count()
-    mine = files[pid::n]
-    if not mine and files:
-        # fewer files than hosts: everyone reads file (pid mod len) so no host
-        # is starved; estimators drop duplicate contributions via batch math
-        mine = [files[pid % len(files)]]
-    return mine
+    if len(files) < n:
+        return files, (pid, n)
+    return files[pid::n], None
+
+
+def _apply_row_slice(shards: XShards, row_slice) -> XShards:
+    if row_slice is None:
+        return shards
+    pid, n = row_slice
+    return shards.transform_shard(
+        lambda d: d.iloc[pid::n] if hasattr(d, "iloc")
+        else jax.tree_util.tree_map(lambda a: a[pid::n], d))
 
 
 def read_csv(file_path: str, num_shards: Optional[int] = None,
              **kwargs: Any) -> XShards:
     """Read CSV file(s)/glob/dir into pandas-DataFrame XShards."""
     import pandas as pd
-    files = _my_files(_expand(file_path, (".csv",)))
-    shards = XShards(files).transform_shard(
-        lambda f: pd.read_csv(f, **kwargs))
+    files, row_slice = _my_files(_expand(file_path, (".csv",)))
+    shards = _apply_row_slice(XShards(files).transform_shard(
+        lambda f: pd.read_csv(f, **kwargs)), row_slice)
     if num_shards and num_shards != shards.num_partitions():
         shards = shards.repartition(num_shards)
     return shards
@@ -62,9 +73,9 @@ def read_csv(file_path: str, num_shards: Optional[int] = None,
 def read_json(file_path: str, num_shards: Optional[int] = None,
               **kwargs: Any) -> XShards:
     import pandas as pd
-    files = _my_files(_expand(file_path, (".json", ".jsonl")))
-    shards = XShards(files).transform_shard(
-        lambda f: pd.read_json(f, **kwargs))
+    files, row_slice = _my_files(_expand(file_path, (".json", ".jsonl")))
+    shards = _apply_row_slice(XShards(files).transform_shard(
+        lambda f: pd.read_json(f, **kwargs)), row_slice)
     if num_shards and num_shards != shards.num_partitions():
         shards = shards.repartition(num_shards)
     return shards
@@ -73,9 +84,9 @@ def read_json(file_path: str, num_shards: Optional[int] = None,
 def read_parquet(file_path: str, num_shards: Optional[int] = None,
                  **kwargs: Any) -> XShards:
     import pandas as pd
-    files = _my_files(_expand(file_path, (".parquet", ".pq")))
-    shards = XShards(files).transform_shard(
-        lambda f: pd.read_parquet(f, **kwargs))
+    files, row_slice = _my_files(_expand(file_path, (".parquet", ".pq")))
+    shards = _apply_row_slice(XShards(files).transform_shard(
+        lambda f: pd.read_parquet(f, **kwargs)), row_slice)
     if num_shards and num_shards != shards.num_partitions():
         shards = shards.repartition(num_shards)
     return shards
@@ -83,9 +94,9 @@ def read_parquet(file_path: str, num_shards: Optional[int] = None,
 
 def read_npz(file_path: str, keys: Optional[Sequence[str]] = None) -> XShards:
     """Read .npz archives into numpy-dict shards (one shard per file)."""
-    files = _my_files(_expand(file_path, (".npz",)))
+    files, row_slice = _my_files(_expand(file_path, (".npz",)))
 
     def load(f):
         with np.load(f) as z:
             return {k: z[k] for k in (keys or z.files)}
-    return XShards(files).transform_shard(load)
+    return _apply_row_slice(XShards(files).transform_shard(load), row_slice)

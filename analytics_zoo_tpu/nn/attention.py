@@ -62,7 +62,7 @@ class MultiHeadAttention(Module):
 
         def proj(name: str, src: jax.Array) -> jax.Array:
             w = scope.param(name, init, (src.shape[-1], h * d_head))
-            y = jnp.dot(x if name == "wq" else src, w,
+            y = jnp.dot(src, w.astype(src.dtype),
                         preferred_element_type=jnp.float32).astype(src.dtype)
             return y.reshape(src.shape[:-1] + (h, d_head))
 
@@ -77,13 +77,10 @@ class MultiHeadAttention(Module):
             ctx = dot_product_attention(q, k, v, mask)
 
         wo = scope.param("wo", init, (h * d_head, d_model))
-        out = jnp.dot(ctx.reshape(x.shape[:-1] + (h * d_head,)), wo,
+        out = jnp.dot(ctx.reshape(x.shape[:-1] + (h * d_head,)),
+                      wo.astype(x.dtype),
                       preferred_element_type=jnp.float32).astype(x.dtype)
-        if self.dropout > 0 and scope.training:
-            keep = 1.0 - self.dropout
-            m = jax.random.bernoulli(scope.make_rng(), keep, out.shape)
-            out = jnp.where(m, out / keep, 0.0)
-        return out
+        return scope.child(Dropout(self.dropout), out, name="drop")
 
 
 class TransformerLayer(Module):

@@ -54,7 +54,7 @@ class Dense(Module):
         y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", self.bias_init, (self.units,))
-            y = y + b
+            y = y + b.astype(y.dtype)  # don't promote bf16 back to f32
         return self.activation(y)
 
 
@@ -294,7 +294,7 @@ class BatchNormalization(Module):
         if self.center:
             y = y + scope.param("beta", initializers.get("zeros"), (dim,)
                                 ).reshape(shape)
-        return y
+        return y.astype(x.dtype)  # running stats are f32; keep compute dtype
 
 
 class LayerNormalization(Module):
@@ -304,12 +304,13 @@ class LayerNormalization(Module):
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         dim = x.shape[-1]
-        mean = x.mean(axis=-1, keepdims=True)
-        var = jnp.square(x - mean).mean(axis=-1, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        xf = x.astype(jnp.float32)  # stats in f32 even for bf16 activations
+        mean = xf.mean(axis=-1, keepdims=True)
+        var = jnp.square(xf - mean).mean(axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
         g = scope.param("gamma", initializers.get("ones"), (dim,))
         b = scope.param("beta", initializers.get("zeros"), (dim,))
-        return y * g + b
+        return (y * g + b).astype(x.dtype)  # keep the compute dtype
 
 
 # -- merge layers (reference: keras merge.Concat/Add/Mul) ----------------------

@@ -59,10 +59,13 @@ class _RNNBase(Module):
             return carry, out
 
         carry, outs = jax.lax.scan(step, carry0, xs)
+        # final output = last *scan* step (for go_backwards that is the end
+        # of the backward pass, NOT the last input-time frame)
+        last = outs[-1]
         if self.go_backwards:
             outs = outs[::-1]
         seq = jnp.swapaxes(outs, 0, 1)  # [B, T, U]
-        out = seq if self.return_sequences else seq[:, -1]
+        out = seq if self.return_sequences else last
         if self.return_state:
             return out, carry
         return out

@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+Reference parity note (SURVEY.md §2.10): the reference's native kernel layer
+was Intel MKL/MKL-DNN behind BigDL's JNI `Engine`.  The TPU-native equivalent
+is (a) XLA's own fusions for almost everything, plus (b) the Pallas kernels in
+this package for the few ops where a hand schedule beats XLA — today that is
+flash attention (O(T) memory softmax-attention, MXU-tiled).
+"""
+
+from .flash_attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

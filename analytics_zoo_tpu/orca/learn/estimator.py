@@ -89,6 +89,7 @@ class ZooEstimator:
         self._eval_step = None
         self._pred_step = None
         self._epoch = 0
+        self._py_step = 0  # host-side mirror of ts["step"] (no device sync)
 
     # -- state ----------------------------------------------------------------
 
@@ -186,9 +187,11 @@ class ZooEstimator:
                     first = False
                 self._ts, loss_val = self._train_step(self._ts, batch)
                 losses.append(loss_val)
-                step = int(self._ts["step"])
+                # track the step in Python: reading self._ts["step"] would
+                # force a device sync on every iteration
+                self._py_step += 1
                 if trigger and self.model_dir and trigger.fires(
-                        step=step, epoch_end=False):
+                        step=self._py_step, epoch_end=False):
                     self.save(self.model_dir)
             self._epoch += 1
             # one host sync per epoch, not per step: losses were left on device
@@ -208,9 +211,8 @@ class ZooEstimator:
                     history.setdefault(f"val_{k}", []).append(v)
                     if self._writer:
                         self._writer.add_scalar(f"val_{k}", v, self._epoch)
-            step = int(self._ts["step"])
-            if trigger and self.model_dir and trigger.fires(step=step,
-                                                            epoch_end=True):
+            if trigger and self.model_dir and trigger.fires(
+                    step=self._py_step, epoch_end=True):
                 self.save(self.model_dir)
         return history
 
@@ -225,17 +227,38 @@ class ZooEstimator:
         feed = as_feed(data, batch_size, shuffle=False, seed=self.seed)
         totals: Optional[List[Any]] = None
         n_batches = 0
-        for batch in feed.epoch(mesh, 0):
-            self._ensure_initialized(batch["x"])
-            stats = self._eval_step(self._ts, batch)
+        if feed.steps_per_epoch() > 0:
+            for batch in feed.epoch(mesh, 0):
+                self._ensure_initialized(batch["x"])
+                stats = self._eval_step(self._ts, batch)
+                if totals is None:
+                    totals = list(stats)
+                else:
+                    totals = [a + b for a, b in zip(totals, stats)]
+                n_batches += 1
+        # the tail rows drop_remainder skipped: one extra (replicated) step so
+        # metrics cover the full dataset exactly.  (Multi-host note: assumes
+        # per-host evaluate over host-local data; stats are host-local sums.)
+        rem = feed.remainder()
+        full_rows = n_batches * feed.global_batch
+        rem_rows = 0
+        if rem is not None:
+            rem_batch = {k: jnp.asarray(v) for k, v in rem.items()}
+            self._ensure_initialized(rem_batch["x"])
+            rem_rows = int(rem_batch["x"].shape[0])
+            stats = self._eval_step(self._ts, rem_batch)
+            # loss entries are per-batch means: convert both to example-sums
             if totals is None:
-                totals = list(stats)
+                totals = [stats[0] * rem_rows] + list(stats[1:])
             else:
-                totals = [a + b for a, b in zip(totals, stats)]
-            n_batches += 1
+                totals = ([totals[0] * feed.global_batch +
+                           stats[0] * rem_rows] +
+                          [a + b for a, b in zip(totals[1:], stats[1:])])
+        elif totals is not None:
+            totals = [totals[0] * feed.global_batch] + totals[1:]
         if totals is None:
             raise ValueError("evaluate got no batches")
-        out = {"loss": float(totals[0]) / n_batches}
+        out = {"loss": float(totals[0]) / (full_rows + rem_rows)}
         for m, stat in zip(self.metrics, totals[1:]):
             out[m.name] = float(m.result(stat))
         return out
@@ -269,6 +292,7 @@ class ZooEstimator:
         path = path or self.model_dir
         tree = ckpt_io.restore(path)
         mesh = get_mesh()
+        self._py_step = int(np.asarray(tree["step"]))
         self._ts = jax.device_put(tree, NamedSharding(mesh, P()))
         if self._train_step is None:
             self._build_steps(mesh)

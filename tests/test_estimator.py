@@ -165,3 +165,24 @@ def test_save_uninitialized_raises(tmp_path):
     est = Estimator.from_keras(mlp(), loss="mse")
     with pytest.raises(ValueError):
         est.save(str(tmp_path / "x"))
+
+
+def test_evaluate_covers_remainder_rows(rng):
+    """evaluate() must include rows beyond the last full batch (regression:
+    code-review finding — previously silently dropped)."""
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(1)])
+    est = Estimator.from_keras(model, loss="mse", metrics=["mae"])
+    x = rng.normal(size=(70, 4)).astype(np.float32)
+    y = np.zeros((70, 1), np.float32)
+    est.fit((x[:32], y[:32]), epochs=1, batch_size=32, verbose=False)
+    res = est.evaluate((x, y), batch_size=32)
+    # mae over ALL 70 rows: hand-compute from the model's own predictions
+    pred = est.predict(x, batch_size=32)
+    expect_mae = float(np.abs(pred - y).mean())
+    assert abs(res["mae"] - expect_mae) < 1e-5
+    expect_loss = float(np.square(pred - y).mean())
+    assert abs(res["loss"] - expect_loss) < 1e-5

@@ -257,3 +257,28 @@ def test_apply_is_pure():
     model.apply(variables, x)
     after = jax.tree_util.tree_map(np.asarray, variables)
     jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+
+
+def test_go_backwards_sees_full_sequence(rng):
+    """go_backwards + return_sequences=False must return the end of the
+    *backward* pass (a summary of the whole sequence), not a one-frame
+    output (regression: code-review finding)."""
+    import analytics_zoo_tpu.nn as nn
+    x = jnp.asarray(rng.normal(size=(3, 7, 5)), jnp.float32)
+    lstm = nn.LSTM(4, go_backwards=True, return_state=True)
+    variables = lstm.init(jax.random.PRNGKey(0), x)
+    (out, (h, c)), _ = lstm.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h), rtol=1e-6)
+    # and it must differ from running on just the last frame
+    out1, _ = lstm.apply(variables, x[:, -1:, :])
+    assert not np.allclose(np.asarray(out), np.asarray(out1[0]))
+
+
+def test_bf16_dtype_preserved_through_stack(rng):
+    """Dense/LayerNorm keep bf16 activations in bf16 (regression)."""
+    import analytics_zoo_tpu.nn as nn
+    x = jnp.asarray(rng.normal(size=(2, 8)), jnp.bfloat16)
+    for layer in [nn.Dense(16), nn.LayerNormalization()]:
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        y, _ = layer.apply(variables, x)
+        assert y.dtype == jnp.bfloat16, type(layer).__name__
