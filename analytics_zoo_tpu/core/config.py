@@ -27,6 +27,7 @@ class MeshConfig:
     - ``data``  : pure data parallelism (batch sharding, gradient psum)
     - ``fsdp``  : data parallelism with parameter/optimizer sharding
     - ``seq``   : sequence/context parallelism (ring attention)
+    - ``pipe``  : pipeline parallelism (GPipe stages over shard_map)
     - ``model`` : tensor parallelism (sharded matmuls)
     - ``expert``: expert parallelism (MoE)
 
@@ -37,10 +38,11 @@ class MeshConfig:
     data: int = 0
     fsdp: int = 1
     seq: int = 1
+    pipe: int = 1
     model: int = 1
     expert: int = 1
 
-    AXIS_ORDER = ("data", "fsdp", "seq", "model", "expert")
+    AXIS_ORDER = ("data", "fsdp", "seq", "pipe", "model", "expert")
 
     def resolved(self, n_devices: int) -> Dict[str, int]:
         """Return a concrete {axis: size} dict.

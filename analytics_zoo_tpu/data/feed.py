@@ -27,10 +27,23 @@ from .shards import XShards
 BATCH_AXES = ("data", "fsdp")  # mesh axes a batch dim is sharded over
 
 
-def batch_sharding(mesh: Mesh, leaf_rank: int = 1) -> NamedSharding:
-    """NamedSharding that shards dim 0 over the mesh's batch axes."""
+def batch_sharding(mesh: Mesh, leaf_rank: int = 1,
+                   seq_dim_size: Optional[int] = None) -> NamedSharding:
+    """NamedSharding that shards dim 0 over the mesh's batch axes.
+
+    ``seq_dim_size``: pass the leaf's dim-1 size to ALSO shard dim 1 over the
+    mesh's ``seq`` axis (sequence/context parallelism) — applied only to
+    feature ('x') leaves whose dim 1 divides the axis; labels and
+    non-divisible shapes stay batch-sharded only."""
     present = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
-    spec = P(present if present else None, *([None] * (leaf_rank - 1)))
+    dim0 = present if present else None
+    seq_ok = (seq_dim_size is not None and leaf_rank >= 2
+              and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+              and seq_dim_size % mesh.shape["seq"] == 0)
+    if seq_ok:
+        spec = P(dim0, "seq", *([None] * (leaf_rank - 2)))
+    else:
+        spec = P(dim0, *([None] * (leaf_rank - 1)))
     return NamedSharding(mesh, spec)
 
 
@@ -52,14 +65,21 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     """
     multi = jax.process_count() > 1
 
-    def place(leaf: np.ndarray) -> jax.Array:
+    def place(leaf: np.ndarray, is_feature: bool) -> jax.Array:
         leaf = np.asarray(leaf)
-        sharding = batch_sharding(mesh, max(leaf.ndim, 1))
+        seq_size = leaf.shape[1] if (is_feature and leaf.ndim >= 2) else None
+        sharding = batch_sharding(mesh, max(leaf.ndim, 1),
+                                  seq_dim_size=seq_size)
         if multi:
             return jax.make_array_from_process_local_data(sharding, leaf)
         return jax.device_put(leaf, sharding)
 
-    return jax.tree_util.tree_map(place, batch)
+    if isinstance(batch, dict):
+        # seq-axis sharding applies to features only, never labels
+        return {k: jax.tree_util.tree_map(
+                    lambda l: place(l, is_feature=(k == "x")), v)
+                for k, v in batch.items()}
+    return jax.tree_util.tree_map(lambda l: place(l, True), batch)
 
 
 class DataFeed:

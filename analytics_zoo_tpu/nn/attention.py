@@ -23,6 +23,12 @@ from .layers import Dense, Dropout, LayerNormalization
 from .module import Module, Scope
 
 
+def causal_mask(t: int) -> jax.Array:
+    """[1, 1, T, T] lower-triangular attend-mask (shared by the dense path
+    and ring_attention's no-seq-axis fallback)."""
+    return (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           ) -> jax.Array:
@@ -43,12 +49,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 class MultiHeadAttention(Module):
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
                  dropout: float = 0.0, use_flash: bool = False,
+                 use_ring: bool = False, causal: bool = False,
                  dtype: Optional[Any] = None, name: Optional[str] = None):
         super().__init__(name)
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.dropout = dropout
         self.use_flash = use_flash
+        self.use_ring = use_ring  # sequence-parallel ring attention (seq axis)
+        self.causal = causal
         self.dtype = dtype
 
     def forward(self, scope: Scope, x: jax.Array,
@@ -70,10 +79,18 @@ class MultiHeadAttention(Module):
         k = proj("wk", kv)
         v = proj("wv", kv)
 
-        if self.use_flash and mask is None:
+        if self.use_ring and mask is None:
+            from analytics_zoo_tpu.parallel import ring_self_attention
+            ctx = ring_self_attention(q, k, v, causal=self.causal)
+        elif self.use_flash and mask is None:
             from analytics_zoo_tpu.ops import flash_attention
-            ctx = flash_attention(q, k, v)
+            ctx = flash_attention(q, k, v, causal=self.causal)
         else:
+            # explicit mask: dense path (flash/ring kernels take no mask);
+            # causal still applies — combine, never silently drop it
+            if self.causal:
+                cm = causal_mask(x.shape[1])
+                mask = cm if mask is None else (mask.astype(bool) & cm)
             ctx = dot_product_attention(q, k, v, mask)
 
         wo = scope.param("wo", init, (h * d_head, d_model))
@@ -89,10 +106,12 @@ class TransformerLayer(Module):
 
     def __init__(self, num_heads: int, hidden_mult: int = 4,
                  dropout: float = 0.0, pre_ln: bool = False,
-                 use_flash: bool = False, name: Optional[str] = None):
+                 use_flash: bool = False, use_ring: bool = False,
+                 causal: bool = False, name: Optional[str] = None):
         super().__init__(name)
         self.mha = MultiHeadAttention(num_heads, dropout=dropout,
-                                      use_flash=use_flash)
+                                      use_flash=use_flash, use_ring=use_ring,
+                                      causal=causal)
         self.hidden_mult = hidden_mult
         self.dropout = dropout
         self.pre_ln = pre_ln

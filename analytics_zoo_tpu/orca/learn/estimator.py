@@ -74,11 +74,18 @@ class ZooEstimator:
                  seed: int = 0,
                  log_dir: Optional[str] = None,
                  app_name: str = "train",
-                 model_dir: Optional[str] = None):
+                 model_dir: Optional[str] = None,
+                 sharding: Any = "dp"):
+        """``sharding``: parameter-sharding strategy over the mesh —
+        "dp" (replicate params; batch sharding only, the reference's only
+        mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
+        "fsdp" (ZeRO-3 over the ``fsdp`` axis), "tp+fsdp", or an explicit
+        list of parallel.ShardingRule."""
         self.model = model
         self.loss_fn = losses_lib.get(loss)
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
+        self.sharding = sharding
         self.seed = seed
         self.model_dir = model_dir
         self._writer = (SummaryWriter(log_dir, app_name)
@@ -99,14 +106,28 @@ class ZooEstimator:
         mesh = get_mesh()
         rng = jax.random.PRNGKey(self.seed)
         variables = self.model.init(rng, example_x, training=True)
-        opt_state = self.tx.init(variables["params"])
-        ts = {"params": variables["params"], "state": variables["state"],
-              "opt_state": opt_state, "step": jnp.zeros((), jnp.int32),
-              "rng": rng}
-        # replicate the train state across the mesh; batches arrive sharded,
-        # so jit's sharding propagation yields psum'd (replicated) gradients
+        rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
-        self._ts = jax.device_put(ts, replicated)
+        if rules:
+            from analytics_zoo_tpu.parallel import shard_variables
+            variables = shard_variables(variables, rules, mesh)
+            # jit propagates the param shardings into mu/nu etc., so the
+            # optimizer state is sharded exactly like its parameters
+            opt_state = _ensure_on_mesh(
+                jax.jit(self.tx.init)(variables["params"]), mesh)
+            params = variables["params"]
+        else:
+            # "dp": replicate params; batches arrive sharded, so jit's
+            # propagation yields psum'd (replicated) gradients
+            params = jax.device_put(variables["params"], replicated)
+            opt_state = jax.device_put(self.tx.init(variables["params"]),
+                                       replicated)
+        ts = {"params": params,
+              "state": jax.device_put(variables["state"], replicated),
+              "opt_state": opt_state,
+              "step": jax.device_put(jnp.zeros((), jnp.int32), replicated),
+              "rng": jax.device_put(rng, replicated)}
+        self._ts = ts
         self._build_steps(mesh)
 
     def _build_steps(self, mesh) -> None:
@@ -293,7 +314,38 @@ class ZooEstimator:
         tree = ckpt_io.restore(path)
         mesh = get_mesh()
         self._py_step = int(np.asarray(tree["step"]))
-        self._ts = jax.device_put(tree, NamedSharding(mesh, P()))
+        rules = _resolve_sharding_rules(self.sharding)
+        replicated = NamedSharding(mesh, P())
+        if rules:
+            # restore under the SAME layout training uses (a plain replicated
+            # device_put would silently drop tp/fsdp sharding)
+            from analytics_zoo_tpu.parallel import infer_param_specs
+            specs = infer_param_specs(tree["params"], rules, mesh)
+            params = jax.tree_util.tree_map(
+                lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+                tree["params"], specs)
+        else:
+            params = jax.device_put(tree["params"], replicated)
+        # checkpoint IO stores optax named-tuples as plain tuples; rebuild the
+        # real structure (and its shardings) from tx.init and pour leaves in
+        ref_opt = _ensure_on_mesh(jax.jit(self.tx.init)(params), mesh)
+        ref_leaves, ref_def = jax.tree_util.tree_flatten(ref_opt)
+        saved_leaves = jax.tree_util.tree_leaves(tree["opt_state"])
+        if len(saved_leaves) == len(ref_leaves):
+            opt_state = jax.tree_util.tree_unflatten(ref_def, [
+                jax.device_put(s, r.sharding) if hasattr(r, "sharding")
+                else s for s, r in zip(saved_leaves, ref_leaves)])
+        else:
+            logger.warning("optimizer state in checkpoint does not match "
+                           "the configured optimizer; reinitialized")
+            opt_state = ref_opt
+        self._ts = {"params": params,
+                    "state": jax.device_put(tree["state"], replicated),
+                    "opt_state": opt_state,
+                    "step": jax.device_put(jnp.asarray(tree["step"]),
+                                           replicated),
+                    "rng": jax.device_put(jnp.asarray(tree["rng"]),
+                                          replicated)}
         if self._train_step is None:
             self._build_steps(mesh)
 
@@ -306,6 +358,40 @@ class ZooEstimator:
 
     def load_orca_checkpoint(self, path: str) -> None:  # reference-parity name
         self.load(path)
+
+
+def _ensure_on_mesh(tree: Any, mesh) -> Any:
+    """Re-place leaves whose sharding is not on ``mesh`` as mesh-replicated
+    (jit can leave freshly created scalars on a single device)."""
+    repl = NamedSharding(mesh, P())
+
+    def fix(leaf):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+            return leaf
+        return jax.device_put(leaf, repl)
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
+def _resolve_sharding_rules(sharding: Any):
+    """"dp" → None; "tp"/"fsdp"/"tp+fsdp" → rule presets; list → as-is."""
+    if sharding is None or sharding == "dp":
+        return None
+    if isinstance(sharding, str):
+        from analytics_zoo_tpu.parallel import (fsdp_rules,
+                                                tensor_parallel_rules)
+        rules = []
+        parts = set(sharding.replace(" ", "").split("+"))
+        unknown = parts - {"tp", "fsdp", "dp"}
+        if unknown:
+            raise ValueError(f"unknown sharding strategy {sharding!r}")
+        if "tp" in parts:
+            rules += tensor_parallel_rules()
+        if "fsdp" in parts:
+            rules += fsdp_rules()
+        return rules or None
+    return list(sharding)
 
 
 def _maybe_select_cols(data: Any, feature_cols: Optional[Sequence[str]],

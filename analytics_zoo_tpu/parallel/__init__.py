@@ -1,0 +1,29 @@
+"""Parallelism over the device mesh: the §2.9 contract and beyond.
+
+Reference (SURVEY.md §2.9): the ONLY parallelism anywhere in the reference
+was synchronous data parallelism, implemented four times (BigDL BlockManager
+all-reduce, Gloo rings under torch.distributed, Horovod, TF collectives).
+TPU-native collapse: one mesh, sharding annotations, XLA-compiled
+collectives.  This package adds what the reference lacked and the TPU makes
+natural:
+
+- :mod:`sharding` — parameter-sharding rules (tensor parallel / FSDP) applied
+  by path pattern; GSPMD propagates and inserts the collectives.
+- :mod:`ring_attention` — sequence/context parallelism over the ``seq`` axis
+  (shard_map + ppermute ring; SURVEY.md §5.7 'post-parity stretch').
+- :mod:`moe` — mixture-of-experts layer, experts sharded over ``expert``.
+- :mod:`pipeline` — GPipe-style pipeline parallelism over the ``pipe`` axis.
+"""
+
+from .sharding import (ShardingRule, infer_param_specs, shard_variables,
+                       tensor_parallel_rules, fsdp_rules)
+from .ring_attention import ring_attention, ring_self_attention
+from .moe import MoE
+from .pipeline import pipeline_apply, stacked_stage_init
+
+__all__ = [
+    "ShardingRule", "infer_param_specs", "shard_variables",
+    "tensor_parallel_rules", "fsdp_rules",
+    "ring_attention", "ring_self_attention",
+    "MoE", "pipeline_apply", "stacked_stage_init",
+]

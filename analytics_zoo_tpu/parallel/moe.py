@@ -1,0 +1,98 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Absent from the reference (SURVEY.md §2.9: 'Expert parallel — ❌ absent').
+TPU-native design: GShard/Switch-style capacity-based dense dispatch — the
+token→expert routing is expressed as einsums against one-hot dispatch/combine
+tensors, so the whole layer is static-shaped and XLA turns the expert-sharded
+einsums into ``all_to_all`` collectives over the ``expert`` mesh axis (via the
+sharding rules in parallel/sharding.py: wi/wo lead with the expert dim).
+
+The load-balancing auxiliary loss is recorded in the state collection under
+``aux_loss`` (pure-function discipline: apply() returns it in new_state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn import activations, initializers
+from analytics_zoo_tpu.nn.module import Module, Scope
+
+
+class MoE(Module):
+    """Token-choice MoE FFN: [B, T, D] → [B, T, D].
+
+    num_experts experts, each a 2-layer FFN (D → D*hidden_mult → D); top_k
+    routing with capacity ``capacity_factor * T*B*top_k / num_experts``.
+    Overflowing tokens are dropped (standard Switch behavior) — the residual
+    connection around the layer carries them through unchanged.
+    """
+
+    def __init__(self, num_experts: int, hidden_mult: int = 4,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: Any = "gelu", name: Optional[str] = None):
+        super().__init__(name or "moe")
+        self.num_experts = num_experts
+        self.hidden_mult = hidden_mult
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.act = activations.get(activation)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        e = self.num_experts
+        s = b * t
+        cap = max(1, int(self.capacity_factor * s * self.top_k / e))
+        init = initializers.get("glorot_uniform")
+
+        wg = scope.param("gate", init, (d, e))
+        wi = scope.param("wi", init, (e, d, d * self.hidden_mult))
+        wo = scope.param("wo", init, (e, d * self.hidden_mult, d))
+
+        xs = x.reshape(s, d)
+        logits = jnp.dot(xs.astype(jnp.float32), wg.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                  # [S, E]
+
+        # top-k sequential assignment: k=0 choices get capacity priority
+        assign = []
+        masked = probs
+        for _ in range(self.top_k):
+            idx = jnp.argmax(masked, axis=-1)                    # [S]
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+            assign.append(onehot)
+            masked = masked * (1.0 - onehot)
+        assign = jnp.stack(assign)                               # [K, S, E]
+
+        # positions: cumulative count in (k-major, then token) order
+        flat = assign.reshape(self.top_k * s, e)
+        pos = jnp.cumsum(flat, axis=0) - flat                    # [K*S, E]
+        pos = pos.reshape(self.top_k, s, e)
+        keep = (pos < cap) * assign                              # [K, S, E]
+
+        gates = jnp.einsum("se,kse->ks", probs, keep)            # [K, S]
+        denom = jnp.maximum(gates.sum(0, keepdims=True), 1e-9)
+        gates = gates / denom                                    # renormalize
+
+        # dispatch/combine [S, E, C]
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                dtype=jnp.float32)               # [K,S,E,C]
+        dispatch = jnp.einsum("kse,ksec->sec", keep, pos_oh)
+        combine = jnp.einsum("ks,kse,ksec->sec", gates, keep, pos_oh)
+
+        xf = xs.astype(jnp.float32)
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch, xf)      # [E, C, D]
+        h = self.act(jnp.einsum("ecd,edh->ech", expert_in,
+                                wi.astype(jnp.float32)))
+        expert_out = jnp.einsum("ech,ehd->ecd", h, wo.astype(jnp.float32))
+        out = jnp.einsum("sec,ecd->sd", combine, expert_out)     # [S, D]
+
+        # Switch load-balancing loss: E * Σ_e (token_frac_e · prob_frac_e)
+        frac_tokens = assign[0].mean(axis=0)                     # [E]
+        frac_probs = probs.mean(axis=0)
+        aux = e * jnp.sum(frac_tokens * frac_probs)
+        scope.put_variable("aux_loss", aux)
+
+        return out.reshape(b, t, d).astype(x.dtype)

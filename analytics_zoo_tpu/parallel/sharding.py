@@ -1,0 +1,126 @@
+"""Parameter-sharding rules: path patterns → PartitionSpec.
+
+How tensor parallelism works here (the TPU-native design, NOT a translation —
+reference had none, SURVEY.md §2.9): parameters are placed with
+``NamedSharding``s chosen by rule; the train step is a plain ``jax.jit``; the
+XLA GSPMD partitioner propagates those shardings through the matmuls and
+inserts the ICI collectives (all-gather / reduce-scatter / psum).  No
+hand-written collective appears in model code.
+
+Conventions the default rules rely on (see nn/layers.py, nn/attention.py):
+- Dense kernels are [in, out]; biases [out].
+- Attention projections wq/wk/wv are [d_model, heads*d_head]; wo is
+  [heads*d_head, d_model].
+- Embedding tables are [vocab, d_model].
+- MoE expert weights are [experts, ...] (leading expert dim).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class ShardingRule:
+    """First regex (full-path search) that matches a ``/``-joined param path
+    wins; ``spec`` may name axes absent from the mesh — they are dropped."""
+    pattern: str
+    spec: P
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+def _trim_spec_to_mesh(spec: P, mesh: Mesh, shape: Sequence[int]) -> P:
+    """Drop axis names not in the mesh / dims that don't divide; keeps the
+    rules portable across mesh shapes (e.g. model=1 ⇒ fully replicated)."""
+    out = []
+    for i, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (
+            (entry,) if entry else ())
+        kept = tuple(n for n in names
+                     if n in mesh.axis_names and mesh.shape[n] > 1)
+        size = 1
+        for n in kept:
+            size *= mesh.shape[n]
+        if i < len(shape) and size > 1 and shape[i] % size == 0:
+            out.append(kept if len(kept) > 1 else
+                       (kept[0] if kept else None))
+        else:
+            out.append(None)
+    while out and out[-1] is None:  # canonical form: P(None, None) == P()
+        out.pop()
+    return P(*out)
+
+
+def tensor_parallel_rules(axis: str = "model") -> List[ShardingRule]:
+    """Megatron-style sharding for the nn layer conventions: column-parallel
+    QKV/FFN-in, row-parallel attention-out/FFN-out, vocab-sharded embedding."""
+    return [
+        # MoE expert weights FIRST: first-match-wins, and the generic wo$
+        # rule below would otherwise shadow the expert-dim placement
+        ShardingRule(r"moe.*wi$", P("expert", None, axis)),
+        ShardingRule(r"moe.*wo$", P("expert", axis, None)),
+        ShardingRule(r"(wq|wk|wv)$", P(None, axis)),
+        ShardingRule(r"wo$", P(axis, None)),
+        ShardingRule(r"ffn1/kernel$", P(None, axis)),
+        ShardingRule(r"ffn2/kernel$", P(axis, None)),
+        ShardingRule(r"embeddings$", P(axis, None)),
+    ]
+
+
+def fsdp_rules(axis: str = "fsdp") -> List[ShardingRule]:
+    """ZeRO-3-style: shard every large kernel's first dim over ``fsdp``;
+    GSPMD all-gathers just-in-time and reduce-scatters gradients."""
+    return [ShardingRule(r"kernel$|embeddings$|(wq|wk|wv|wo)$",
+                         P(axis, None))]
+
+
+def infer_param_specs(params: Any, rules: Sequence[ShardingRule],
+                      mesh: Mesh) -> Any:
+    """PartitionSpec pytree for a params pytree (unmatched → replicated)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path_entries, leaf) -> P:
+        path = "/".join(_key_str(k) for k in path_entries)
+        for rule in rules:
+            if rule.matches(path):
+                return _trim_spec_to_mesh(rule.spec, mesh, leaf.shape)
+        return P()
+
+    specs = {jax.tree_util.keystr(p): spec_for(p, l) for p, l in flat}
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: specs[jax.tree_util.keystr(p)], params)
+
+
+def shard_variables(variables: Any, rules: Sequence[ShardingRule],
+                    mesh: Mesh) -> Any:
+    """device_put a {"params", "state", ...} tree with rule-derived shardings
+    (non-params collections are replicated)."""
+    def place(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+            tree, specs)
+
+    out = dict(variables)
+    if "params" in variables:
+        specs = infer_param_specs(variables["params"], rules, mesh)
+        out["params"] = place(variables["params"], specs)
+    for k, v in variables.items():
+        if k != "params":
+            out[k] = jax.tree_util.tree_map(
+                lambda l: jax.device_put(l, NamedSharding(mesh, P())), v)
+    return out
+
+
+def _key_str(k: Any) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
