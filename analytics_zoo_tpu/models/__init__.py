@@ -1,0 +1,27 @@
+"""Built-in model zoo (reference: zoo.models — SURVEY.md §2.7).
+
+Every family from the reference's Scala+Py twin zoo, rebuilt as pure-JAX
+modules over analytics_zoo_tpu.nn: recommendation (NeuralCF, WideAndDeep,
+SessionRecommender), text classification, text matching (KNRM), anomaly
+detection, seq2seq, image classification (ResNet), object detection (SSD),
+plus the BERT family the reference shipped through TFPark.
+"""
+
+from .common import ZooModel
+from .recommendation import (NeuralCF, SessionRecommender, UserItemFeature,
+                             UserItemPrediction, WideAndDeep)
+from .textclassification import TextClassifier
+from .textmatching import KNRM
+from .anomalydetection import AnomalyDetector, unroll
+from .seq2seq import Seq2seq, RNNEncoder, RNNDecoder
+from .image import ImageClassifier, ResNet
+from .objectdetection import ObjectDetector, SSDLite
+from .bert import BERT, BERTClassifier, BERTSQuAD
+
+__all__ = [
+    "ZooModel", "NeuralCF", "WideAndDeep", "SessionRecommender",
+    "UserItemFeature", "UserItemPrediction", "TextClassifier", "KNRM",
+    "AnomalyDetector", "unroll", "Seq2seq", "RNNEncoder", "RNNDecoder",
+    "ImageClassifier", "ResNet", "ObjectDetector", "SSDLite",
+    "BERT", "BERTClassifier", "BERTSQuAD",
+]

@@ -1,0 +1,65 @@
+"""AnomalyDetector (reference: zoo.models.anomalydetection —
+models/anomalydetection/AnomalyDetector.scala + Unroll helpers).
+
+Stacked-LSTM next-value regressor over unrolled windows; anomalies = points
+whose prediction error ranks in the top ``anomaly_fraction``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import analytics_zoo_tpu.nn as nn
+from .common import ZooModel
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding windows: series [N, F] → (x [M, unroll, F], y [M])
+    (reference: AnomalyDetector.unroll on an RDD; here vectorized numpy)."""
+    data = np.asarray(data)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series shorter than unroll_length + predict_step")
+    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
+    x = data[idx]
+    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+class AnomalyDetector(ZooModel):
+    def __init__(self, feature_shape: Sequence[int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2)):
+        super().__init__()
+        self._config = dict(feature_shape=list(feature_shape),
+                            hidden_layers=list(hidden_layers),
+                            dropouts=list(dropouts))
+        self.feature_shape = tuple(feature_shape)
+        self.hidden_layers = list(hidden_layers)
+        self.dropouts = list(dropouts)
+
+    def forward(self, scope, x):
+        h = x
+        for i, (units, rate) in enumerate(zip(self.hidden_layers,
+                                              self.dropouts)):
+            last = i == len(self.hidden_layers) - 1
+            h = scope.child(nn.LSTM(units, return_sequences=not last), h,
+                            name=f"lstm_{i}")
+            h = scope.child(nn.Dropout(rate), h, name=f"drop_{i}")
+        return scope.child(nn.Dense(1), h, name="head")
+
+    def detect_anomalies(self, y_true: np.ndarray, y_pred: np.ndarray,
+                         anomaly_fraction: float = 0.05) -> np.ndarray:
+        """Indices of the top-fraction absolute errors (reference:
+        detectAnomalies RDD sort → threshold)."""
+        y_true = np.asarray(y_true).reshape(-1)
+        y_pred = np.asarray(y_pred).reshape(-1)
+        err = np.abs(y_true - y_pred)
+        k = max(1, int(len(err) * anomaly_fraction))
+        thresh = np.sort(err)[-k]
+        return np.where(err >= thresh)[0]

@@ -1,0 +1,121 @@
+"""ZooModel: the model-zoo base class.
+
+Reference (SURVEY.md §2.7 'common'): ``ZooModel`` (zoo/.../models/common/
+ZooModel.scala) gave every built-in model BigDL-protobuf save/load,
+``predictClasses`` and fit/predict plumbing through KerasNet.
+
+TPU-native: a ZooModel IS an nn.Module; ``compile`` attaches the unified
+Estimator (orca.learn) so ``fit/evaluate/predict`` run the jit-compiled,
+mesh-sharded path; ``save_model/load_model`` round-trip weights (checkpoint
+IO) + the constructor config (JSON) in one directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.core import checkpoint as ckpt_io
+from analytics_zoo_tpu.nn.module import Module
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class ZooModel(Module):
+    """Base: subclasses set ``self._config = {...}`` (constructor kwargs)
+    before/inside __init__ and implement ``forward``."""
+
+    _config: Dict[str, Any]
+
+    def __init_subclass__(cls, **kw: Any):
+        super().__init_subclass__(**kw)
+        _REGISTRY[cls.__name__] = cls
+
+    # -- training plumbing ----------------------------------------------------
+
+    def compile(self, loss: Any, optimizer: Any = "adam",
+                learning_rate: Optional[float] = None,
+                metrics: Optional[Sequence[Any]] = None,
+                **kwargs: Any) -> "ZooModel":
+        from analytics_zoo_tpu.orca.learn import Estimator
+        self._estimator = Estimator.from_keras(
+            self, loss=loss, optimizer=optimizer,
+            learning_rate=learning_rate, metrics=metrics, **kwargs)
+        return self
+
+    @property
+    def estimator(self):
+        if getattr(self, "_estimator", None) is None:
+            raise ValueError(f"{type(self).__name__}: call compile() (or "
+                             "set_estimator) before fit/evaluate/predict")
+        return self._estimator
+
+    def fit(self, data: Any, epochs: int = 1, batch_size: int = 32,
+            **kwargs: Any) -> Dict[str, Any]:
+        return self.estimator.fit(data, epochs=epochs, batch_size=batch_size,
+                                  **kwargs)
+
+    def evaluate(self, data: Any, batch_size: int = 32,
+                 **kwargs: Any) -> Dict[str, float]:
+        return self.estimator.evaluate(data, batch_size=batch_size, **kwargs)
+
+    def predict(self, data: Any, batch_size: int = 32,
+                **kwargs: Any) -> np.ndarray:
+        return self.estimator.predict(data, batch_size=batch_size, **kwargs)
+
+    def predict_classes(self, data: Any, batch_size: int = 32) -> np.ndarray:
+        """Reference: ZooModel.predictClasses — argmax over output dist."""
+        out = self.predict(data, batch_size=batch_size)
+        if out.ndim > 1 and out.shape[-1] > 1:
+            return np.argmax(out, axis=-1)
+        return (out.reshape(len(out), -1)[:, 0] > 0).astype(np.int64)
+
+    # -- persistence ----------------------------------------------------------
+
+    def save_model(self, path: str) -> str:
+        """Weights + config in one directory (reference: saveModule)."""
+        est = getattr(self, "_estimator", None)
+        if est is None or est._ts is None:
+            raise ValueError("model has no trained/initialized weights; "
+                             "compile() and run fit/predict first")
+        os.makedirs(path, exist_ok=True)
+        ckpt_io.save(os.path.join(path, "weights"), est.get_model())
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({"class": type(self).__name__,
+                       "config": self._config}, f)
+        return path
+
+    @staticmethod
+    def load_model(path: str) -> "ZooModel":
+        """Rebuild from a save_model directory (class + config + weights)."""
+        with open(os.path.join(path, "config.json")) as f:
+            meta = json.load(f)
+        cls = _REGISTRY[meta["class"]]
+        model = cls(**meta["config"])
+        model._loaded_variables = ckpt_io.restore(
+            os.path.join(path, "weights"))
+        return model
+
+    # loaded weights are injected into the estimator on first use
+    def compile_with_loaded(self, loss: Any, **kw: Any) -> "ZooModel":
+        self.compile(loss, **kw)
+        lv = getattr(self, "_loaded_variables", None)
+        if lv is not None:
+            est = self._estimator
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from analytics_zoo_tpu.core import get_mesh
+            mesh = get_mesh()
+            repl = NamedSharding(mesh, P())
+            opt_state = est.tx.init(lv["params"])
+            est._ts = jax.device_put(
+                {"params": lv["params"], "state": lv.get("state", {}),
+                 "opt_state": opt_state,
+                 "step": jnp.zeros((), jnp.int32),
+                 "rng": jax.random.PRNGKey(est.seed)}, repl)
+            est._build_steps(mesh)
+        return self

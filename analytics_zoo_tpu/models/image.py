@@ -1,0 +1,139 @@
+"""Image classification (reference: zoo.models.image.imageclassification —
+ImageClassifier wrapper over pretrained zoo/bigdl models).
+
+TPU-native: ResNet v1.5 built in NHWC with bf16-friendly conv blocks — the
+BASELINE ResNet-50/ImageNet config.  ``ImageClassifier`` wraps any backbone
+with the reference's configure/predict API (top-k labels).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.nn.module import Module, Scope
+from .common import ZooModel
+
+_SPECS: Dict[int, Tuple[Tuple[int, ...], bool]] = {
+    # depth: (blocks per stage, bottleneck?)
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+    101: ((3, 4, 23, 3), True),
+    152: ((3, 8, 36, 3), True),
+}
+
+
+class _ResBlock(Module):
+    def __init__(self, filters: int, stride: int, bottleneck: bool,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.stride = stride
+        self.bottleneck = bottleneck
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        f = self.filters
+        out_f = f * 4 if self.bottleneck else f
+        shortcut = x
+        if x.shape[-1] != out_f or self.stride != 1:
+            shortcut = scope.child(
+                nn.Conv2D(out_f, 1, strides=self.stride, use_bias=False),
+                x, name="proj")
+            shortcut = scope.child(nn.BatchNormalization(), shortcut,
+                                   name="proj_bn")
+        if self.bottleneck:
+            h = scope.child(nn.Conv2D(f, 1, use_bias=False), x, name="conv1")
+            h = scope.child(nn.BatchNormalization(), h, name="bn1")
+            h = jax.nn.relu(h)
+            h = scope.child(nn.Conv2D(f, 3, strides=self.stride,
+                                      use_bias=False), h, name="conv2")
+            h = scope.child(nn.BatchNormalization(), h, name="bn2")
+            h = jax.nn.relu(h)
+            h = scope.child(nn.Conv2D(out_f, 1, use_bias=False), h,
+                            name="conv3")
+            h = scope.child(nn.BatchNormalization(), h, name="bn3")
+        else:
+            h = scope.child(nn.Conv2D(f, 3, strides=self.stride,
+                                      use_bias=False), x, name="conv1")
+            h = scope.child(nn.BatchNormalization(), h, name="bn1")
+            h = jax.nn.relu(h)
+            h = scope.child(nn.Conv2D(f, 3, use_bias=False), h, name="conv2")
+            h = scope.child(nn.BatchNormalization(), h, name="bn2")
+        return jax.nn.relu(h + shortcut)
+
+
+class ResNet(ZooModel):
+    """ResNet v1.5 (stride-2 on the 3x3), NHWC.  depth ∈ {18,34,50,101,152}."""
+
+    def __init__(self, depth: int = 50, class_num: int = 1000,
+                 width: int = 64, include_top: bool = True,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._config = dict(depth=depth, class_num=class_num, width=width,
+                            include_top=include_top, dtype=dtype)
+        if depth not in _SPECS:
+            raise ValueError(f"depth must be one of {sorted(_SPECS)}")
+        self.depth = depth
+        self.class_num = class_num
+        self.width = width
+        self.include_top = include_top
+        self.dtype = dtype
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        """x: [B, H, W, C] images (NHWC — TPU-native layout; the reference
+        used NCHW for MKL-DNN)."""
+        blocks, bottleneck = _SPECS[self.depth]
+        if self.dtype == "bfloat16":
+            x = x.astype(jnp.bfloat16)
+        h = scope.child(nn.Conv2D(self.width, 7, strides=2, use_bias=False),
+                        x, name="stem")
+        h = scope.child(nn.BatchNormalization(), h, name="stem_bn")
+        h = jax.nn.relu(h)
+        h = scope.child(nn.MaxPooling2D(3, strides=2, padding="same"), h,
+                        name="stem_pool")
+        for stage, n_blocks in enumerate(blocks):
+            f = self.width * (2 ** stage)
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and stage > 0) else 1
+                h = scope.child(_ResBlock(f, stride, bottleneck), h,
+                                name=f"stage{stage}_block{b}")
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        if not self.include_top:
+            return h
+        return scope.child(nn.Dense(self.class_num),
+                           h.astype(jnp.float32), name="head")
+
+
+class ImageClassifier(ZooModel):
+    """Reference API wrapper: backbone + labels + topN predict
+    (models/image/imageclassification/ImageClassifier.scala)."""
+
+    def __init__(self, depth: int = 50, class_num: int = 1000,
+                 labels: Optional[Sequence[str]] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._config = dict(depth=depth, class_num=class_num,
+                            labels=list(labels) if labels else None,
+                            dtype=dtype)
+        self.backbone = ResNet(depth=depth, class_num=class_num, dtype=dtype)
+        self.labels = list(labels) if labels else None
+        self.class_num = class_num
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return scope.child(self.backbone, x, name="resnet")
+
+    def predict_image_set(self, images: np.ndarray, top_n: int = 5
+                          ) -> List[List[Tuple[Any, float]]]:
+        probs = np.asarray(jax.nn.softmax(
+            jnp.asarray(self.predict(images)), axis=-1))
+        out = []
+        for row in probs:
+            top = np.argsort(-row)[:top_n]
+            out.append([(self.labels[i] if self.labels else int(i),
+                         float(row[i])) for i in top])
+        return out

@@ -1,0 +1,176 @@
+"""Object detection (reference: zoo.models.image.objectdetection —
+SSD-VGG/MobileNet pipelines: ObjectDetector load + ImageConfigure +
+postprocess NMS/ScaleDetection + Visualizer).
+
+TPU-native redesign: ``SSDLite`` — an SSD head over a ResNet backbone's
+multi-scale feature maps, anchors generated per level; the conv trunk +
+box/class heads run compiled on device, decode + class-wise NMS run on host
+numpy (small, latency-bound — the reference also postprocessed on CPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.nn.module import Module, Scope
+from .common import ZooModel
+from .image import ResNet
+
+
+def _make_anchors(fm_sizes: Sequence[Tuple[int, int]],
+                  scales: Sequence[float],
+                  ratios: Sequence[float] = (1.0, 2.0, 0.5)) -> np.ndarray:
+    """Center-form anchors [(cx, cy, w, h)] normalized to [0,1]."""
+    out = []
+    for (fh, fw), scale in zip(fm_sizes, scales):
+        for i in range(fh):
+            for j in range(fw):
+                cx, cy = (j + 0.5) / fw, (i + 0.5) / fh
+                for r in ratios:
+                    w = scale * np.sqrt(r)
+                    h = scale / np.sqrt(r)
+                    out.append([cx, cy, w, h])
+    return np.asarray(out, np.float32)
+
+
+def decode_boxes(loc: np.ndarray, anchors: np.ndarray,
+                 variances: Tuple[float, float] = (0.1, 0.2)) -> np.ndarray:
+    """SSD box decoding: loc deltas + anchors → corner-form [x1,y1,x2,y2]."""
+    cxcy = anchors[:, :2] + loc[:, :2] * variances[0] * anchors[:, 2:]
+    wh = anchors[:, 2:] * np.exp(loc[:, 2:] * variances[1])
+    return np.concatenate([cxcy - wh / 2, cxcy + wh / 2], axis=1)
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.45,
+        top_k: int = 200) -> List[int]:
+    """Greedy class-wise NMS (reference: postprocess Nms.scala)."""
+    order = np.argsort(-scores)[:top_k]
+    keep: List[int] = []
+    while len(order):
+        i = order[0]
+        keep.append(int(i))
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a_i = ((boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1]))
+        a_r = ((boxes[rest, 2] - boxes[rest, 0]) *
+               (boxes[rest, 3] - boxes[rest, 1]))
+        iou = inter / np.clip(a_i + a_r - inter, 1e-9, None)
+        order = rest[iou <= iou_threshold]
+    return keep
+
+
+class SSDLite(ZooModel):
+    """SSD head over ResNet stages 2..4 + one extra stride-2 level."""
+
+    N_RATIOS = 3
+
+    def __init__(self, class_num: int = 21, backbone_depth: int = 18,
+                 image_size: int = 128):
+        super().__init__()
+        self._config = dict(class_num=class_num,
+                            backbone_depth=backbone_depth,
+                            image_size=image_size)
+        self.class_num = class_num
+        self.image_size = image_size
+        self.backbone = ResNet(depth=backbone_depth, include_top=False)
+        # feature strides 8/16/32/64 on image_size → map sizes
+        s = image_size
+        self.fm_sizes = [(s // 8, s // 8), (s // 16, s // 16),
+                         (s // 32, s // 32), (s // 64, s // 64)]
+        self.scales = [0.1, 0.25, 0.45, 0.7]
+        self.anchors = _make_anchors(self.fm_sizes, self.scales)
+
+    def _features(self, scope: Scope, x: jax.Array) -> List[jax.Array]:
+        """Run the ResNet trunk, tapping stages 1..3 + an extra conv level."""
+        rn = self.backbone
+        from .image import _SPECS, _ResBlock
+        blocks, bottleneck = _SPECS[rn.depth]
+        h = scope.child(nn.Conv2D(rn.width, 7, strides=2, use_bias=False),
+                        x, name="stem")
+        h = scope.child(nn.BatchNormalization(), h, name="stem_bn")
+        h = jax.nn.relu(h)
+        h = scope.child(nn.MaxPooling2D(3, strides=2, padding="same"), h,
+                        name="stem_pool")
+        taps = []
+        for stage, n_blocks in enumerate(blocks):
+            f = rn.width * (2 ** stage)
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and stage > 0) else 1
+                h = scope.child(_ResBlock(f, stride, bottleneck), h,
+                                name=f"stage{stage}_block{b}")
+            if stage >= 1:
+                taps.append(h)
+        extra = scope.child(nn.Conv2D(256, 3, strides=2, activation="relu"),
+                            taps[-1], name="extra")
+        return taps + [extra]
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        """Returns [B, n_anchors, 4 + class_num] (loc ++ class logits)."""
+        feats = self._features(scope, x)
+        locs, clss = [], []
+        k = self.N_RATIOS
+        for i, f in enumerate(feats):
+            loc = scope.child(nn.Conv2D(k * 4, 3), f, name=f"loc_{i}")
+            cls = scope.child(nn.Conv2D(k * self.class_num, 3), f,
+                              name=f"cls_{i}")
+            b, fh, fw, _ = loc.shape
+            locs.append(loc.reshape(b, fh * fw * k, 4))
+            clss.append(cls.reshape(b, fh * fw * k, self.class_num))
+        return jnp.concatenate(
+            [jnp.concatenate(locs, axis=1), jnp.concatenate(clss, axis=1)],
+            axis=-1)
+
+
+class ObjectDetector(ZooModel):
+    """Reference-API wrapper: predict_image_set → per-image detections
+    [(class, score, [x1,y1,x2,y2]), ...] after decode + NMS."""
+
+    def __init__(self, class_num: int = 21, backbone_depth: int = 18,
+                 image_size: int = 128,
+                 labels: Optional[Sequence[str]] = None):
+        super().__init__()
+        self._config = dict(class_num=class_num,
+                            backbone_depth=backbone_depth,
+                            image_size=image_size,
+                            labels=list(labels) if labels else None)
+        self.ssd = SSDLite(class_num, backbone_depth, image_size)
+        self.class_num = class_num
+        self.labels = list(labels) if labels else None
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return scope.child(self.ssd, x, name="ssd")
+
+    def predict_image_set(self, images: np.ndarray,
+                          score_threshold: float = 0.5,
+                          iou_threshold: float = 0.45
+                          ) -> List[List[Tuple[Any, float, np.ndarray]]]:
+        raw = self.predict(np.asarray(images))
+        anchors = self.ssd.anchors
+        results = []
+        for row in raw:
+            loc, logits = row[:, :4], row[:, 4:]
+            probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+            boxes = decode_boxes(loc, anchors)
+            dets = []
+            for c in range(1, self.class_num):  # 0 = background
+                sc = probs[:, c]
+                sel = np.where(sc >= score_threshold)[0]
+                if not len(sel):
+                    continue
+                for i in nms(boxes[sel], sc[sel], iou_threshold):
+                    label = self.labels[c] if self.labels else c
+                    dets.append((label, float(sc[sel][i]), boxes[sel][i]))
+            dets.sort(key=lambda d: -d[1])
+            results.append(dets)
+        return results

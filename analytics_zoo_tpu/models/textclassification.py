@@ -1,0 +1,46 @@
+"""TextClassifier (reference: zoo.models.textclassification —
+models/textclassification/TextClassifier.scala + py twin).
+
+encoder="cnn": embedding → temporal conv → global max pool (the reference's
+default CNN text classifier); "lstm"/"gru": recurrent encoder, last output.
+Input: int token ids [B, T] (from feature.text.TextSet's word2idx pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import analytics_zoo_tpu.nn as nn
+from .common import ZooModel
+
+
+class TextClassifier(ZooModel):
+    def __init__(self, class_num: int, vocab_size: int = 20000,
+                 token_length: int = 200, sequence_length: int = 500,
+                 encoder: str = "cnn", encoder_output_dim: int = 256):
+        super().__init__()
+        self._config = dict(class_num=class_num, vocab_size=vocab_size,
+                            token_length=token_length,
+                            sequence_length=sequence_length, encoder=encoder,
+                            encoder_output_dim=encoder_output_dim)
+        for k, v in self._config.items():
+            setattr(self, k, v)
+        if encoder not in ("cnn", "lstm", "gru"):
+            raise ValueError(f"unknown encoder {encoder!r}")
+
+    def forward(self, scope, ids):
+        x = scope.child(nn.Embedding(self.vocab_size, self.token_length),
+                        ids, name="embed")
+        if self.encoder == "cnn":
+            h = scope.child(nn.Conv1D(self.encoder_output_dim, 5,
+                                      activation="relu"), x, name="conv")
+            h = jnp.max(h, axis=1)  # global max pool over time
+        elif self.encoder == "lstm":
+            h = scope.child(nn.LSTM(self.encoder_output_dim), x, name="lstm")
+        else:
+            h = scope.child(nn.GRU(self.encoder_output_dim), x, name="gru")
+        h = scope.child(nn.Dense(128, activation="relu"), h, name="fc1")
+        h = scope.child(nn.Dropout(0.2), h, name="drop")
+        return scope.child(nn.Dense(self.class_num), h, name="head")

@@ -23,10 +23,12 @@ from .layers import Dense, Dropout, LayerNormalization
 from .module import Module, Scope
 
 
-def causal_mask(t: int) -> jax.Array:
-    """[1, 1, T, T] lower-triangular attend-mask (shared by the dense path
-    and ring_attention's no-seq-axis fallback)."""
-    return (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
+def causal_mask(tq: int, tk: Optional[int] = None) -> jax.Array:
+    """[1, 1, Tq, Tk] lower-triangular attend-mask (shared by the dense path
+    and ring_attention's no-seq-axis fallback); handles Tq != Tk
+    (cross-attention) by comparing absolute positions."""
+    tk = tq if tk is None else tk
+    return (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])[None, None]
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -89,7 +91,7 @@ class MultiHeadAttention(Module):
             # explicit mask: dense path (flash/ring kernels take no mask);
             # causal still applies — combine, never silently drop it
             if self.causal:
-                cm = causal_mask(x.shape[1])
+                cm = causal_mask(x.shape[1], kv.shape[1])
                 mask = cm if mask is None else (mask.astype(bool) & cm)
             ctx = dot_product_attention(q, k, v, mask)
 

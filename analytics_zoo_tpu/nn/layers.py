@@ -152,8 +152,9 @@ class Conv2D(Module):
         in_ch = x.shape[-1]
         w = scope.param("kernel", self.kernel_init,
                         (kh, kw, in_ch // self.groups, self.filters))
+        xc = _cast_for_compute(x, self.dtype)
         y = jax.lax.conv_general_dilated(
-            _cast_for_compute(x, self.dtype), _cast_for_compute(w, self.dtype),
+            xc, _cast_for_compute(w, self.dtype).astype(xc.dtype),
             window_strides=self.strides, padding=self.padding,
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -162,7 +163,7 @@ class Conv2D(Module):
         y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"), (self.filters,))
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.activation(y)
 
 

@@ -75,7 +75,8 @@ class ZooEstimator:
                  log_dir: Optional[str] = None,
                  app_name: str = "train",
                  model_dir: Optional[str] = None,
-                 sharding: Any = "dp"):
+                 sharding: Any = "dp",
+                 aux_loss_weight: float = 0.01):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -86,6 +87,7 @@ class ZooEstimator:
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self.sharding = sharding
+        self.aux_loss_weight = aux_loss_weight
         self.seed = seed
         self.model_dir = model_dir
         self._writer = (SummaryWriter(log_dir, app_name)
@@ -133,6 +135,7 @@ class ZooEstimator:
     def _build_steps(self, mesh) -> None:
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
         metrics = self.metrics
+        aux_w = self.aux_loss_weight
 
         def train_step(ts, batch):
             step_rng = jax.random.fold_in(ts["rng"], ts["step"])
@@ -141,7 +144,10 @@ class ZooEstimator:
                 out, new_state = model.apply(
                     {"params": params, "state": ts["state"]}, batch["x"],
                     training=True, rng=step_rng)
-                return loss_fn(out, batch["y"]), new_state
+                loss = loss_fn(out, batch["y"])
+                # auxiliary losses recorded in state (e.g. MoE load-balance)
+                loss = loss + aux_w * _collect_aux_losses(new_state)
+                return loss, new_state
 
             (loss_val, new_state), grads = jax.value_and_grad(
                 lossf, has_aux=True)(ts["params"])
@@ -360,6 +366,16 @@ class ZooEstimator:
         self.load(path)
 
 
+def _collect_aux_losses(state: Any) -> jax.Array:
+    """Sum every ``aux_loss`` leaf in a state pytree (MoE layers record
+    their load-balancing loss there; pure-function discipline)."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if path and getattr(path[-1], "key", None) == "aux_loss":
+            total = total + leaf.astype(jnp.float32)
+    return total
+
+
 def _ensure_on_mesh(tree: Any, mesh) -> Any:
     """Re-place leaves whose sharding is not on ``mesh`` as mesh-replicated
     (jit can leave freshly created scalars on a single device)."""
@@ -387,9 +403,11 @@ def _resolve_sharding_rules(sharding: Any):
         if unknown:
             raise ValueError(f"unknown sharding strategy {sharding!r}")
         if "tp" in parts:
-            rules += tensor_parallel_rules()
+            # composed tp+fsdp: the non-tp dim of each tp kernel goes to fsdp
+            rules += tensor_parallel_rules(
+                fsdp_axis="fsdp" if "fsdp" in parts else None)
         if "fsdp" in parts:
-            rules += fsdp_rules()
+            rules += fsdp_rules()  # remaining kernels: plain ZeRO-3
         return rules or None
     return list(sharding)
 

@@ -73,8 +73,12 @@ class MoE(Module):
         keep = (pos < cap) * assign                              # [K, S, E]
 
         gates = jnp.einsum("se,kse->ks", probs, keep)            # [K, S]
-        denom = jnp.maximum(gates.sum(0, keepdims=True), 1e-9)
-        gates = gates / denom                                    # renormalize
+        if self.top_k > 1:
+            # renormalize among the chosen experts (GShard top-2 behavior)
+            denom = jnp.maximum(gates.sum(0, keepdims=True), 1e-9)
+            gates = gates / denom
+        # top-1 (Switch): keep the raw softmax prob — renormalizing to 1.0
+        # would sever the router's gradient from the task loss
 
         # dispatch/combine [S, E, C]
         pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
@@ -89,7 +93,10 @@ class MoE(Module):
         expert_out = jnp.einsum("ech,ehd->ecd", h, wo.astype(jnp.float32))
         out = jnp.einsum("sec,ecd->sd", combine, expert_out)     # [S, D]
 
-        # Switch load-balancing loss: E * Σ_e (token_frac_e · prob_frac_e)
+        # Switch load-balancing loss: E * Σ_e (token_frac_e · prob_frac_e).
+        # Declare at init (zeros) so the state pytree structure is stable
+        # across init/apply — lax.scan carries require it.
+        scope.variable("aux_loss", lambda: jnp.zeros((), jnp.float32))
         frac_tokens = assign[0].mean(axis=0)                     # [E]
         frac_probs = probs.mean(axis=0)
         aux = e * jnp.sum(frac_tokens * frac_probs)

@@ -58,19 +58,27 @@ def _trim_spec_to_mesh(spec: P, mesh: Mesh, shape: Sequence[int]) -> P:
     return P(*out)
 
 
-def tensor_parallel_rules(axis: str = "model") -> List[ShardingRule]:
+def tensor_parallel_rules(axis: str = "model",
+                          fsdp_axis: Optional[str] = None
+                          ) -> List[ShardingRule]:
     """Megatron-style sharding for the nn layer conventions: column-parallel
-    QKV/FFN-in, row-parallel attention-out/FFN-out, vocab-sharded embedding."""
+    QKV/FFN-in, row-parallel attention-out/FFN-out, vocab-sharded embedding.
+
+    ``fsdp_axis``: compose with ZeRO-3 — the dim NOT sharded over ``axis``
+    is sharded over the fsdp axis (first-match-wins means a plain
+    tp-rules + fsdp-rules concatenation would leave tp-matched kernels
+    replicated across fsdp)."""
+    f = fsdp_axis
     return [
         # MoE expert weights FIRST: first-match-wins, and the generic wo$
         # rule below would otherwise shadow the expert-dim placement
-        ShardingRule(r"moe.*wi$", P("expert", None, axis)),
-        ShardingRule(r"moe.*wo$", P("expert", axis, None)),
-        ShardingRule(r"(wq|wk|wv)$", P(None, axis)),
-        ShardingRule(r"wo$", P(axis, None)),
-        ShardingRule(r"ffn1/kernel$", P(None, axis)),
-        ShardingRule(r"ffn2/kernel$", P(axis, None)),
-        ShardingRule(r"embeddings$", P(axis, None)),
+        ShardingRule(r"moe.*wi$", P("expert", f, axis)),
+        ShardingRule(r"moe.*wo$", P("expert", axis, f)),
+        ShardingRule(r"(wq|wk|wv)$", P(f, axis)),
+        ShardingRule(r"wo$", P(axis, f)),
+        ShardingRule(r"ffn1/kernel$", P(f, axis)),
+        ShardingRule(r"ffn2/kernel$", P(axis, f)),
+        ShardingRule(r"embeddings$", P(axis, f)),
     ]
 
 

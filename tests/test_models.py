@@ -1,0 +1,209 @@
+"""Model zoo tests (reference test pattern, SURVEY.md §4: build the model,
+train/predict on tiny synthetic data, save/load round-trip)."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context("local")
+    yield
+
+
+def test_neuralcf_train_and_recommend(rng):
+    from analytics_zoo_tpu.models import NeuralCF, UserItemPrediction
+    m = NeuralCF(user_count=20, item_count=30, class_num=2,
+                 hidden_layers=(16, 8))
+    m.compile(loss="sparse_categorical_crossentropy", learning_rate=0.01,
+              metrics=["accuracy"])
+    x = np.stack([rng.integers(0, 20, 256), rng.integers(0, 30, 256)], 1)
+    y = ((x[:, 0] + x[:, 1]) % 2).astype(np.int32)  # learnable parity rule
+    hist = m.fit((x.astype(np.int32), y), epochs=5, batch_size=64,
+                 verbose=False)
+    assert hist["loss"][-1] < hist["loss"][0]
+    recs = m.recommend_for_user([1, 2], max_items=3)
+    assert len(recs) == 6
+    assert all(isinstance(r, UserItemPrediction) for r in recs)
+    recs_i = m.recommend_for_item([5], max_users=4)
+    assert len(recs_i) == 4 and all(r.item_id == 5 for r in recs_i)
+
+
+def test_neuralcf_save_load_roundtrip(rng, tmp_path):
+    from analytics_zoo_tpu.models import NeuralCF, ZooModel
+    m = NeuralCF(user_count=10, item_count=10, hidden_layers=(8,))
+    m.compile(loss="sparse_categorical_crossentropy")
+    x = np.stack([rng.integers(0, 10, 32), rng.integers(0, 10, 32)], 1
+                 ).astype(np.int32)
+    y = rng.integers(0, 2, 32).astype(np.int32)
+    m.fit((x, y), epochs=1, batch_size=16, verbose=False)
+    p1 = m.predict(x)
+    path = str(tmp_path / "ncf")
+    m.save_model(path)
+    m2 = ZooModel.load_model(path)
+    m2.compile_with_loaded(loss="sparse_categorical_crossentropy")
+    p2 = m2.predict(x)
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_wide_and_deep_forward(rng):
+    from analytics_zoo_tpu.models import WideAndDeep
+    m = WideAndDeep(class_num=2, wide_base_dims=(5, 5), wide_cross_dims=(10,),
+                    indicator_dims=(3,), embed_in_dims=(20, 20),
+                    embed_out_dims=(4, 4), continuous_cols=2,
+                    hidden_layers=(16, 8))
+    m.compile(loss="sparse_categorical_crossentropy", learning_rate=0.01)
+    n = 64
+    wide = (rng.random((n, 20)) < 0.1).astype(np.float32)
+    ind = (rng.random((n, 3)) < 0.3).astype(np.float32)
+    emb = rng.integers(0, 20, (n, 2)).astype(np.float32)
+    cont = rng.normal(size=(n, 2)).astype(np.float32)
+    x = np.concatenate([wide, ind, emb, cont], axis=1)
+    y = rng.integers(0, 2, n).astype(np.int32)
+    hist = m.fit((x, y), epochs=2, batch_size=32, verbose=False)
+    assert np.isfinite(hist["loss"][-1])
+    for mt in ("wide", "deep"):
+        sub = WideAndDeep(class_num=2, model_type=mt, wide_base_dims=(5, 5),
+                          wide_cross_dims=(10,), indicator_dims=(3,),
+                          embed_in_dims=(20, 20), embed_out_dims=(4, 4),
+                          continuous_cols=2, hidden_layers=(8,))
+        out, _ = sub.apply(sub.init(jax.random.PRNGKey(0), x[:4]), x[:4])
+        assert out.shape == (4, 2)
+
+
+def test_session_recommender(rng):
+    from analytics_zoo_tpu.models import SessionRecommender
+    m = SessionRecommender(item_count=50, item_embed=16,
+                           rnn_hidden_layers=(16, 8), session_length=6,
+                           include_history=True, history_length=4)
+    m.compile(loss="sparse_categorical_crossentropy", learning_rate=0.01)
+    x = rng.integers(0, 50, (64, 10)).astype(np.int32)
+    y = rng.integers(0, 50, 64).astype(np.int32)
+    hist = m.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    assert np.isfinite(hist["loss"][0])
+    recs = m.recommend_for_session(x[:3], max_items=4)
+    assert len(recs) == 3 and len(recs[0]) == 4
+
+
+def test_text_classifier_all_encoders(rng):
+    from analytics_zoo_tpu.models import TextClassifier
+    x = rng.integers(0, 100, (32, 20)).astype(np.int32)
+    y = rng.integers(0, 3, 32).astype(np.int32)
+    for enc in ("cnn", "lstm", "gru"):
+        m = TextClassifier(class_num=3, vocab_size=100, token_length=16,
+                           sequence_length=20, encoder=enc,
+                           encoder_output_dim=16)
+        m.compile(loss="sparse_categorical_crossentropy", learning_rate=0.01)
+        hist = m.fit((x, y), epochs=1, batch_size=16, verbose=False)
+        assert np.isfinite(hist["loss"][0]), enc
+        assert m.predict_classes(x).shape == (32,)
+
+
+def test_knrm_ranking(rng):
+    from analytics_zoo_tpu.models import KNRM
+    m = KNRM(text1_length=5, text2_length=10, vocab_size=50, embed_size=16,
+             kernel_num=11)
+    m.compile(loss="binary_crossentropy", learning_rate=0.01)
+    x = rng.integers(0, 50, (64, 15)).astype(np.int32)
+    # matching docs share tokens with query
+    y = np.array([1.0 if len(set(r[:5]) & set(r[5:])) else 0.0 for r in x],
+                 np.float32)[:, None]
+    hist = m.fit((x, y), epochs=3, batch_size=32, verbose=False)
+    assert np.isfinite(hist["loss"][-1])
+
+
+def test_anomaly_detector_pipeline(rng):
+    from analytics_zoo_tpu.models import AnomalyDetector, unroll
+    t = np.arange(300, dtype=np.float32)
+    series = np.sin(t / 10) + 0.05 * rng.normal(size=300)
+    series[250] += 5.0  # inject an anomaly
+    x, y = unroll(series, unroll_length=10)
+    assert x.shape == (290, 10, 1) and y.shape == (290,)
+    m = AnomalyDetector(feature_shape=(10, 1), hidden_layers=(8, 8),
+                        dropouts=(0.0, 0.0))
+    m.compile(loss="mse", learning_rate=0.01)
+    m.fit((x, y[:, None]), epochs=3, batch_size=64, verbose=False)
+    pred = m.predict(x)
+    anomalies = m.detect_anomalies(y, pred, anomaly_fraction=0.01)
+    # the injected spike (unrolled index 240 = point 250) must be flagged
+    assert any(235 <= a <= 245 for a in anomalies)
+
+
+def test_seq2seq_fit_and_infer(rng):
+    from analytics_zoo_tpu.models import Seq2seq
+    m = Seq2seq(vocab_size=20, embed_dim=16, hidden_size=16,
+                encoder_length=6, decoder_length=4, use_attention=True)
+    m.compile(loss="sparse_categorical_crossentropy", learning_rate=0.01)
+    x = rng.integers(0, 20, (64, 10)).astype(np.int32)
+    y = rng.integers(0, 20, (64, 4)).astype(np.int32)
+    hist = m.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    assert np.isfinite(hist["loss"][0])
+    decoded = m.infer(x[:3, :6], start_id=0, max_length=4)
+    assert decoded.shape == (3, 4)
+    assert decoded.dtype in (np.int32, np.int64)
+
+
+def test_resnet_variants(rng):
+    from analytics_zoo_tpu.models import ResNet
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    for depth in (18, 50):
+        m = ResNet(depth=depth, class_num=10)
+        variables = m.init(jax.random.PRNGKey(0), x)
+        out, _ = m.apply(variables, x)
+        assert out.shape == (2, 10), depth
+    # bf16 path keeps f32 head output
+    m = ResNet(depth=18, class_num=10, dtype="bfloat16")
+    out, _ = m.apply(m.init(jax.random.PRNGKey(0), x), x)
+    assert out.dtype == np.float32 or str(out.dtype) == "float32"
+
+
+def test_image_classifier_topn(rng):
+    from analytics_zoo_tpu.models import ImageClassifier
+    labels = [f"class_{i}" for i in range(10)]
+    m = ImageClassifier(depth=18, class_num=10, labels=labels)
+    m.compile(loss="sparse_categorical_crossentropy")
+    imgs = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    preds = m.predict_image_set(imgs, top_n=3)
+    assert len(preds) == 4 and len(preds[0]) == 3
+    assert preds[0][0][0].startswith("class_")
+
+
+def test_ssd_object_detector(rng):
+    from analytics_zoo_tpu.models import ObjectDetector
+    from analytics_zoo_tpu.models.objectdetection import nms
+    m = ObjectDetector(class_num=4, backbone_depth=18, image_size=64)
+    m.compile(loss="mse")
+    imgs = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    raw = m.predict(imgs)
+    assert raw.shape[0] == 2 and raw.shape[2] == 4 + 4
+    assert raw.shape[1] == len(m.ssd.anchors)
+    dets = m.predict_image_set(imgs, score_threshold=0.0)
+    assert len(dets) == 2
+    # NMS sanity: overlapping boxes collapse
+    boxes = np.array([[0, 0, 1, 1], [0, 0, 0.95, 0.95], [2, 2, 3, 3]],
+                     np.float32)
+    keep = nms(boxes, np.array([0.9, 0.8, 0.7], np.float32), 0.5)
+    assert keep == [0, 2]
+
+
+def test_bert_classifier_and_squad(rng):
+    from analytics_zoo_tpu.models import BERTClassifier, BERTSQuAD
+    from analytics_zoo_tpu.models.bert import squad_span_loss
+    kw = dict(vocab_size=100, hidden_size=32, n_layers=2, n_heads=2,
+              max_position=16)
+    x = rng.integers(0, 100, (8, 12)).astype(np.int32)
+    m = BERTClassifier(class_num=3, **kw)
+    m.compile(loss="sparse_categorical_crossentropy", learning_rate=1e-3)
+    y = rng.integers(0, 3, 8).astype(np.int32)
+    hist = m.fit((x, y), epochs=1, batch_size=8, verbose=False)
+    assert np.isfinite(hist["loss"][0])
+
+    sq = BERTSQuAD(**kw)
+    sq.compile(loss=squad_span_loss, learning_rate=1e-3)
+    spans = np.stack([rng.integers(0, 12, 8), rng.integers(0, 12, 8)], 1
+                     ).astype(np.int32)
+    hist = sq.fit((x, spans), epochs=1, batch_size=8, verbose=False)
+    assert np.isfinite(hist["loss"][0])
