@@ -1,0 +1,17 @@
+"""AutoML (reference: pyzoo/zoo/orca/automl — SURVEY.md §2.5).
+
+The reference ran Ray Tune trials across Spark executors.  TPU-native
+redesign: trials are plain Python callables over the jit-compiled Estimator;
+the search engine schedules them in-process (sequentially on the pod, or
+thread-parallel for CPU-bound trials) with ASHA-style early stopping — no
+Ray, no cluster bootstrap (SURVEY.md §7: 'AutoML trial scheduling without
+Ray').
+"""
+
+from . import hp
+from .search import (ASHAScheduler, GridSearchEngine, RandomSearchEngine,
+                     SearchEngine, Trial)
+from .auto_estimator import AutoEstimator
+
+__all__ = ["hp", "AutoEstimator", "SearchEngine", "RandomSearchEngine",
+           "GridSearchEngine", "ASHAScheduler", "Trial"]

@@ -1,0 +1,162 @@
+"""Search engines + ASHA early stopping.
+
+Reference (SURVEY.md §2.5): ``SearchEngine`` abstraction with a
+``RayTuneSearchEngine`` implementation (pyzoo/zoo/orca/automl/search/) —
+Tune workers trained one trial each, the ASHA scheduler killed stragglers.
+
+TPU-native: a trial is ``fn(config, report) -> result``; ``report(metric,
+step)`` streams intermediate results so ASHA can stop a trial early (the
+callback raises StopTrial).  Engines run trials in-process — sequential by
+default (one TPU pod = one trial at a time; the reference's parallelism came
+from having a CPU cluster), optional thread pool for host-bound trials.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import hp as hp_mod
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class StopTrial(Exception):
+    """Raised inside report() when the scheduler prunes the trial."""
+
+
+@dataclass
+class Trial:
+    trial_id: int
+    config: Dict[str, Any]
+    metric: Optional[float] = None     # best reported (per mode)
+    history: List[float] = field(default_factory=list)
+    status: str = "pending"            # pending | done | pruned | error
+    error: Optional[str] = None
+    duration_s: float = 0.0
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving: at each rung (step budget
+    grace_period * reduction_factor^k), a trial continues only if its metric
+    is in the top 1/reduction_factor of completed rung results."""
+
+    def __init__(self, metric_mode: str = "min", grace_period: int = 1,
+                 reduction_factor: int = 3, max_t: int = 100):
+        self.mode = metric_mode
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def _rung_of(self, step: int) -> Optional[int]:
+        t = self.grace
+        while t <= self.max_t:
+            if step == t:
+                return t
+            t *= self.rf
+        return None
+
+    def on_report(self, trial: Trial, metric: float, step: int) -> bool:
+        """Returns False if the trial should be pruned now."""
+        rung = self._rung_of(step)
+        if rung is None:
+            return True
+        key = metric if self.mode == "min" else -metric
+        with self._lock:
+            peers = self._rungs.setdefault(rung, [])
+            peers.append(key)
+            if len(peers) < self.rf:      # not enough evidence yet
+                return True
+            cutoff = np.quantile(peers, 1.0 / self.rf)
+            return key <= cutoff
+
+
+class SearchEngine:
+    """Base: subclasses yield configs; run_trials executes + tracks them."""
+
+    def __init__(self, metric_mode: str = "min",
+                 scheduler: Optional[ASHAScheduler] = None,
+                 max_concurrent: int = 1, seed: int = 0):
+        self.mode = metric_mode
+        self.scheduler = scheduler
+        self.max_concurrent = max_concurrent
+        self.rng = np.random.default_rng(seed)
+        self.trials: List[Trial] = []
+
+    def configs(self, space: Dict[str, Any], n_trials: int
+                ) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def run(self, trial_fn: Callable, space: Dict[str, Any],
+            n_trials: int = 8) -> Trial:
+        """trial_fn(config, report) → final metric (float) or dict with
+        'metric'.  Returns the best Trial."""
+        configs = self.configs(space, n_trials)
+        self.trials = [Trial(i, c) for i, c in enumerate(configs)]
+
+        def execute(trial: Trial) -> None:
+            t0 = time.time()
+
+            def report(metric: float, step: int) -> None:
+                trial.history.append(float(metric))
+                if self.scheduler and not self.scheduler.on_report(
+                        trial, float(metric), step):
+                    raise StopTrial()
+
+            try:
+                trial.status = "running"
+                out = trial_fn(dict(trial.config), report)
+                metric = out["metric"] if isinstance(out, dict) else out
+                trial.metric = float(metric)
+                trial.status = "done"
+            except StopTrial:
+                trial.status = "pruned"
+                if trial.history:
+                    trial.metric = (min(trial.history) if self.mode == "min"
+                                    else max(trial.history))
+            except Exception as e:  # noqa: BLE001 — a trial may fail freely
+                trial.status = "error"
+                trial.error = f"{type(e).__name__}: {e}"
+                logger.warning("trial %d failed: %s", trial.trial_id,
+                               trial.error)
+            trial.duration_s = time.time() - t0
+
+        if self.max_concurrent > 1:
+            with ThreadPoolExecutor(self.max_concurrent) as pool:
+                list(pool.map(execute, self.trials))
+        else:
+            for t in self.trials:
+                execute(t)
+
+        scored = [t for t in self.trials if t.metric is not None]
+        if not scored:
+            errs = [t.error for t in self.trials if t.error]
+            raise RuntimeError(f"all {len(self.trials)} trials failed; "
+                               f"first error: {errs[0] if errs else '?'}")
+        best = (min if self.mode == "min" else max)(
+            scored, key=lambda t: t.metric)
+        logger.info("search done: best trial %d metric=%.5f config=%s",
+                    best.trial_id, best.metric, best.config)
+        return best
+
+
+class RandomSearchEngine(SearchEngine):
+    def configs(self, space, n_trials):
+        return [hp_mod.sample(space, self.rng) for _ in range(n_trials)]
+
+
+class GridSearchEngine(SearchEngine):
+    def configs(self, space, n_trials):
+        grid = hp_mod.grid(space)
+        if n_trials and len(grid) > n_trials:
+            idx = self.rng.permutation(len(grid))[:n_trials]
+            grid = [grid[i] for i in idx]
+        return grid

@@ -1,0 +1,172 @@
+"""AutoTS (reference: pyzoo/zoo/chronos/autots — AutoTSEstimator searching
+model type + hyperparams + lookback via Tune; result wrapped as TSPipeline
+with save/load).
+
+TPU-native: search runs on the automl package (no Ray); the model space is
+{lstm, seq2seq, tcn}; lookback may itself be a search dimension (re-rolling
+the TSDataset per trial, as the reference did).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.automl import hp as hp_mod
+from analytics_zoo_tpu.automl.search import (ASHAScheduler,
+                                             RandomSearchEngine, StopTrial)
+from .forecaster import (LSTMForecaster, Seq2SeqForecaster, TCNForecaster)
+
+_MODELS = {"lstm": LSTMForecaster, "seq2seq": Seq2SeqForecaster,
+           "tcn": TCNForecaster}
+
+
+class TSPipeline:
+    """fitted forecaster + the tsdata scaler: predict/evaluate/save/load."""
+
+    def __init__(self, forecaster, config: Dict[str, Any], scaler=None):
+        self.forecaster = forecaster
+        self.config = config
+        self.scaler = scaler
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return self.forecaster.predict(x)
+
+    def evaluate(self, data) -> Dict[str, float]:
+        return self.forecaster.evaluate(data)
+
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        self.forecaster.save(os.path.join(path, "model"))
+
+        def jsonable(v) -> bool:
+            if isinstance(v, (int, float, str, bool, type(None))):
+                return True
+            if isinstance(v, (list, tuple)):
+                return all(jsonable(x) for x in v)
+            if isinstance(v, dict):  # model_kwargs must survive the trip
+                return all(isinstance(k, str) and jsonable(x)
+                           for k, x in v.items())
+            return False
+
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({k: v for k, v in self.config.items() if jsonable(v)},
+                      f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)
+        model_cls = _MODELS[config["model"]]
+        fc = model_cls(
+            past_seq_len=config["past_seq_len"],
+            future_seq_len=config["future_seq_len"],
+            input_feature_num=config["input_feature_num"],
+            output_feature_num=config["output_feature_num"],
+            **config.get("model_kwargs", {}))
+        # initialize then load weights
+        fc.est.load(os.path.join(path, "model"))
+        return TSPipeline(fc, config)
+
+
+class AutoTSEstimator:
+    def __init__(self, model: Any = "lstm",
+                 search_space: Optional[Dict[str, Any]] = None,
+                 past_seq_len: Any = 24, future_seq_len: int = 1,
+                 metric: str = "mse", metric_mode: str = "min",
+                 seed: int = 0):
+        """``model``: name, list of names, or hp.choice over names."""
+        if isinstance(model, str):
+            model = [model]
+        self.model_space = (model if isinstance(model, hp_mod.Sampler)
+                            else hp_mod.choice(list(model)))
+        self.search_space = dict(search_space or {})
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.metric = metric
+        self.metric_mode = metric_mode
+        self.seed = seed
+        self.best_config: Optional[Dict[str, Any]] = None
+
+    def fit(self, data, validation_data=None, epochs: int = 2,
+            batch_size: int = 32, n_sampling: int = 4,
+            scheduler: Optional[ASHAScheduler] = None) -> TSPipeline:
+        """``data``: a TSDataset (re-rolled per lookback candidate) or a
+        rolled (x, y) tuple."""
+        from .data import TSDataset
+        is_tsdata = isinstance(data, TSDataset)
+        space = dict(self.search_space)
+        space["model"] = self.model_space
+        if isinstance(self.past_seq_len, hp_mod.Sampler):
+            space["past_seq_len"] = self.past_seq_len
+        engine = RandomSearchEngine(metric_mode=self.metric_mode,
+                                    scheduler=scheduler, seed=self.seed)
+
+        def make(config: Dict[str, Any]):
+            cfg = dict(config)
+            name = cfg.pop("model")
+            lookback = int(cfg.pop("past_seq_len", self.past_seq_len))
+            lr = cfg.pop("lr", 1e-3)
+            if is_tsdata:
+                data.roll(lookback, self.future_seq_len)
+                x, y = data.to_numpy()
+            else:
+                x, y = data
+                lookback = x.shape[1]
+            fc = _MODELS[name](past_seq_len=lookback,
+                               future_seq_len=self.future_seq_len,
+                               input_feature_num=x.shape[-1],
+                               output_feature_num=y.shape[-1], lr=lr,
+                               metrics=[self.metric] if self.metric != "loss"
+                               else ("mse",), **cfg)
+            return fc, (x, y), dict(config)
+
+        def trial_fn(config, report):
+            fc, (x, y), _ = make(config)
+            if validation_data is not None:
+                vx, vy = (validation_data.to_numpy()
+                          if hasattr(validation_data, "to_numpy")
+                          else validation_data)
+            else:
+                n_val = max(1, len(x) // 5)
+                vx, vy = x[-n_val:], y[-n_val:]
+                x, y = x[:-n_val], y[:-n_val]
+            best = None
+            for epoch in range(epochs):
+                fc.fit((x, y), epochs=1,
+                       batch_size=min(batch_size, len(x)))
+                m = fc.evaluate((vx, vy),
+                                batch_size=min(batch_size, len(vx)))
+                m = m.get(self.metric, m["loss"])
+                if best is None or (m < best if self.metric_mode == "min"
+                                    else m > best):
+                    best = m
+                report(m, epoch + 1)
+            return best
+
+        best = engine.run(trial_fn, space, n_trials=n_sampling)
+        self.best_config = dict(best.config)
+        self.trials = engine.trials
+        # refit winner on the full data
+        fc, (x, y), raw_cfg = make(dict(best.config))
+        fc.fit((x, y), epochs=epochs, batch_size=min(batch_size, len(x)))
+        cfg = dict(raw_cfg)
+        cfg.update(model=best.config["model"],
+                   past_seq_len=fc.past_seq_len,
+                   future_seq_len=self.future_seq_len,
+                   input_feature_num=fc.input_feature_num,
+                   output_feature_num=fc.output_feature_num,
+                   model_kwargs={k: v for k, v in raw_cfg.items()
+                                 if k not in ("model", "past_seq_len", "lr",
+                                              "batch_size")})
+        return TSPipeline(fc, cfg,
+                          scaler=getattr(data, "scaler", None))
+
+    def get_best_config(self) -> Dict[str, Any]:
+        if self.best_config is None:
+            raise ValueError("call fit() first")
+        return dict(self.best_config)

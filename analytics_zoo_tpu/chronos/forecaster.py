@@ -1,0 +1,241 @@
+"""Forecasters (reference: pyzoo/zoo/chronos/forecaster/*.py — one class per
+model, uniform fit/predict/evaluate/save/load).
+
+LSTM / Seq2Seq (enc-dec GRU-or-LSTM) / TCN (dilated temporal conv) run on the
+unified Estimator (jit-compiled, mesh-aware).  ARIMA/Prophet wrap optional
+CPU libraries (statsmodels/prophet) and are import-gated exactly like the
+reference gated pmdarima/prophet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.nn.module import Module
+from analytics_zoo_tpu.orca.learn import Estimator
+
+
+# -- model trunks -------------------------------------------------------------
+
+class _VanillaLSTM(Module):
+    def __init__(self, hidden_dim=32, layer_num=1, dropout=0.1,
+                 output_dim=1, horizon=1):
+        super().__init__()
+        self.hidden_dim, self.layer_num = hidden_dim, layer_num
+        self.dropout, self.output_dim, self.horizon = (dropout, output_dim,
+                                                       horizon)
+
+    def forward(self, scope, x):
+        h = x
+        for i in range(self.layer_num):
+            last = i == self.layer_num - 1
+            h = scope.child(nn.LSTM(self.hidden_dim,
+                                    return_sequences=not last), h,
+                            name=f"lstm_{i}")
+            h = scope.child(nn.Dropout(self.dropout), h, name=f"drop_{i}")
+        out = scope.child(nn.Dense(self.horizon * self.output_dim), h,
+                          name="head")
+        return out.reshape(x.shape[0], self.horizon, self.output_dim)
+
+
+class _Seq2SeqTS(Module):
+    def __init__(self, lstm_hidden_dim=32, lstm_layer_num=1, dropout=0.1,
+                 output_dim=1, horizon=1, rnn_type="lstm", teacher=False):
+        super().__init__()
+        self.hidden = lstm_hidden_dim
+        self.layers = lstm_layer_num
+        self.dropout, self.output_dim, self.horizon = (dropout, output_dim,
+                                                       horizon)
+        self.rnn_type = rnn_type
+
+    def forward(self, scope, x):
+        cls = nn.LSTM if self.rnn_type == "lstm" else nn.GRU
+        h = x
+        for i in range(self.layers):
+            h = scope.child(cls(self.hidden, return_sequences=True), h,
+                            name=f"enc_{i}")
+        summary = h[:, -1]                               # [B, H]
+        # decoder: repeat the summary as input for each horizon step
+        dec_in = jnp.repeat(summary[:, None, :], self.horizon, axis=1)
+        d = dec_in
+        for i in range(self.layers):
+            d = scope.child(cls(self.hidden, return_sequences=True), d,
+                            name=f"dec_{i}")
+        d = scope.child(nn.Dropout(self.dropout), d, name="drop")
+        return scope.child(nn.Dense(self.output_dim), d, name="head")
+
+
+class _TCN(Module):
+    """Dilated temporal convolution network (reference:
+    pyzoo/zoo/chronos/model/tcn.py — Bai et al. TCN): causal convs via
+    left-padding, residual blocks, exponentially growing dilation."""
+
+    def __init__(self, num_channels: Sequence[int] = (32, 32),
+                 kernel_size: int = 3, dropout: float = 0.1,
+                 output_dim: int = 1, horizon: int = 1):
+        super().__init__()
+        self.num_channels = list(num_channels)
+        self.kernel_size = kernel_size
+        self.dropout = dropout
+        self.output_dim = output_dim
+        self.horizon = horizon
+
+    def forward(self, scope, x):
+        h = x                                            # [B, T, F]
+        for i, ch in enumerate(self.num_channels):
+            dilation = 2 ** i
+            pad = (self.kernel_size - 1) * dilation
+            blk_in = h
+            for j in range(2):
+                hp = jnp.pad(h, ((0, 0), (pad, 0), (0, 0)))  # causal pad
+                h = scope.child(
+                    nn.Conv1D(ch, self.kernel_size, padding="valid",
+                              dilation=dilation, activation="relu"),
+                    hp, name=f"tcn{i}_conv{j}")
+                h = scope.child(nn.Dropout(self.dropout), h,
+                                name=f"tcn{i}_drop{j}")
+            if blk_in.shape[-1] != ch:
+                blk_in = scope.child(nn.Dense(ch), blk_in, name=f"tcn{i}_proj")
+            h = jnp.maximum(h + blk_in, 0)
+        out = scope.child(nn.Dense(self.horizon * self.output_dim),
+                          h[:, -1], name="head")
+        return out.reshape(x.shape[0], self.horizon, self.output_dim)
+
+
+# -- forecaster base ----------------------------------------------------------
+
+class _Forecaster:
+    MODEL_CLS: Any = None
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 loss: str = "mse", optimizer: str = "adam",
+                 lr: float = 1e-3, metrics: Sequence[str] = ("mse",),
+                 seed: int = 0, **model_kwargs: Any):
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.input_feature_num = input_feature_num
+        self.output_feature_num = output_feature_num
+        self.model_kwargs = model_kwargs
+        self.model = self._build_model()
+        self.est = Estimator.from_keras(
+            self.model, loss=loss, optimizer=optimizer, learning_rate=lr,
+            metrics=list(metrics), seed=seed)
+
+    def _build_model(self) -> Module:
+        return self.MODEL_CLS(output_dim=self.output_feature_num,
+                              horizon=self.future_seq_len,
+                              **self.model_kwargs)
+
+    @classmethod
+    def from_tsdataset(cls, tsdata, past_seq_len: int = 24,
+                       future_seq_len: int = 1, **kwargs: Any):
+        tsdata.roll(past_seq_len, future_seq_len)
+        x, y = tsdata.to_numpy()
+        fc = cls(past_seq_len=past_seq_len, future_seq_len=future_seq_len,
+                 input_feature_num=x.shape[-1],
+                 output_feature_num=y.shape[-1], **kwargs)
+        fc._tsdata_xy = (x, y)
+        return fc
+
+    def fit(self, data: Any = None, epochs: int = 1, batch_size: int = 32,
+            validation_data: Any = None) -> Dict[str, Any]:
+        if data is None:
+            data = getattr(self, "_tsdata_xy", None)
+            if data is None:
+                raise ValueError("pass data or use from_tsdataset")
+        return self.est.fit(data, epochs=epochs, batch_size=batch_size,
+                            validation_data=validation_data, verbose=False)
+
+    def predict(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
+        return self.est.predict(np.asarray(x, np.float32),
+                                batch_size=batch_size)
+
+    def evaluate(self, data: Tuple[np.ndarray, np.ndarray],
+                 batch_size: int = 32) -> Dict[str, float]:
+        return self.est.evaluate(data, batch_size=batch_size)
+
+    def save(self, path: str) -> str:
+        return self.est.save(path)
+
+    def load(self, path: str) -> None:
+        self.est.load(path)
+
+    restore = load  # older reference name
+
+
+class LSTMForecaster(_Forecaster):
+    MODEL_CLS = _VanillaLSTM
+
+
+class Seq2SeqForecaster(_Forecaster):
+    MODEL_CLS = _Seq2SeqTS
+
+
+class TCNForecaster(_Forecaster):
+    MODEL_CLS = _TCN
+
+
+# -- classical (optional CPU deps, gated like the reference) ------------------
+
+class ARIMAForecaster:
+    """statsmodels ARIMA (reference: chronos/model/arima.py used pmdarima)."""
+
+    def __init__(self, order: Tuple[int, int, int] = (1, 0, 0),
+                 seasonal_order: Tuple[int, int, int, int] = (0, 0, 0, 0)):
+        try:
+            from statsmodels.tsa.arima.model import ARIMA  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "ARIMAForecaster requires statsmodels; it is an optional "
+                "CPU dependency (reference gated pmdarima the same way)"
+            ) from e
+        self.order = order
+        self.seasonal_order = seasonal_order
+        self._fitted = None
+
+    def fit(self, data: np.ndarray) -> "ARIMAForecaster":
+        from statsmodels.tsa.arima.model import ARIMA
+        self._fitted = ARIMA(np.asarray(data, np.float64), order=self.order,
+                             seasonal_order=self.seasonal_order).fit()
+        return self
+
+    def predict(self, horizon: int = 1) -> np.ndarray:
+        if self._fitted is None:
+            raise ValueError("fit first")
+        return np.asarray(self._fitted.forecast(horizon))
+
+    def evaluate(self, y_true: np.ndarray, horizon: Optional[int] = None
+                 ) -> Dict[str, float]:
+        pred = self.predict(horizon or len(y_true))
+        err = pred - np.asarray(y_true)
+        return {"mse": float(np.mean(err ** 2)),
+                "mae": float(np.mean(np.abs(err)))}
+
+
+class ProphetForecaster:
+    """prophet wrapper (optional dep, import-gated)."""
+
+    def __init__(self, **prophet_kwargs: Any):
+        try:
+            from prophet import Prophet  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "ProphetForecaster requires the optional 'prophet' package"
+            ) from e
+        self.kwargs = prophet_kwargs
+        self._m = None
+
+    def fit(self, df) -> "ProphetForecaster":
+        from prophet import Prophet
+        self._m = Prophet(**self.kwargs)
+        self._m.fit(df)
+        return self
+
+    def predict(self, horizon: int = 1, freq: str = "D"):
+        future = self._m.make_future_dataframe(periods=horizon, freq=freq)
+        return self._m.predict(future).tail(horizon)

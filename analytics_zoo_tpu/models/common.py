@@ -44,7 +44,29 @@ class ZooModel(Module):
         self._estimator = Estimator.from_keras(
             self, loss=loss, optimizer=optimizer,
             learning_rate=learning_rate, metrics=metrics, **kwargs)
+        self._inject_loaded_weights()
         return self
+
+    def _inject_loaded_weights(self) -> None:
+        """After load_model(), any compile() starts from the loaded weights
+        rather than a fresh random init."""
+        lv = getattr(self, "_loaded_variables", None)
+        if lv is None:
+            return
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from analytics_zoo_tpu.core import get_mesh
+        est = self._estimator
+        mesh = get_mesh()
+        repl = NamedSharding(mesh, P())
+        opt_state = est.tx.init(lv["params"])
+        est._ts = jax.device_put(
+            {"params": lv["params"], "state": lv.get("state", {}),
+             "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32),
+             "rng": jax.random.PRNGKey(est.seed)}, repl)
+        est._build_steps(mesh)
 
     @property
     def estimator(self):
@@ -99,23 +121,6 @@ class ZooModel(Module):
             os.path.join(path, "weights"))
         return model
 
-    # loaded weights are injected into the estimator on first use
+    # back-compat alias: compile() now injects loaded weights itself
     def compile_with_loaded(self, loss: Any, **kw: Any) -> "ZooModel":
-        self.compile(loss, **kw)
-        lv = getattr(self, "_loaded_variables", None)
-        if lv is not None:
-            est = self._estimator
-            import jax
-            import jax.numpy as jnp
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from analytics_zoo_tpu.core import get_mesh
-            mesh = get_mesh()
-            repl = NamedSharding(mesh, P())
-            opt_state = est.tx.init(lv["params"])
-            est._ts = jax.device_put(
-                {"params": lv["params"], "state": lv.get("state", {}),
-                 "opt_state": opt_state,
-                 "step": jnp.zeros((), jnp.int32),
-                 "rng": jax.random.PRNGKey(est.seed)}, repl)
-            est._build_steps(mesh)
-        return self
+        return self.compile(loss, **kw)

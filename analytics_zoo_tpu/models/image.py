@@ -72,21 +72,24 @@ class ResNet(ZooModel):
 
     def __init__(self, depth: int = 50, class_num: int = 1000,
                  width: int = 64, include_top: bool = True,
-                 dtype: str = "float32"):
+                 return_stages: bool = False, dtype: str = "float32"):
         super().__init__()
         self._config = dict(depth=depth, class_num=class_num, width=width,
-                            include_top=include_top, dtype=dtype)
+                            include_top=include_top,
+                            return_stages=return_stages, dtype=dtype)
         if depth not in _SPECS:
             raise ValueError(f"depth must be one of {sorted(_SPECS)}")
         self.depth = depth
         self.class_num = class_num
         self.width = width
         self.include_top = include_top
+        self.return_stages = return_stages
         self.dtype = dtype
 
-    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+    def forward(self, scope: Scope, x: jax.Array):
         """x: [B, H, W, C] images (NHWC — TPU-native layout; the reference
-        used NCHW for MKL-DNN)."""
+        used NCHW for MKL-DNN).  return_stages=True yields the per-stage
+        feature maps (stages 1..3) for detection heads."""
         blocks, bottleneck = _SPECS[self.depth]
         if self.dtype == "bfloat16":
             x = x.astype(jnp.bfloat16)
@@ -96,12 +99,17 @@ class ResNet(ZooModel):
         h = jax.nn.relu(h)
         h = scope.child(nn.MaxPooling2D(3, strides=2, padding="same"), h,
                         name="stem_pool")
+        taps = []
         for stage, n_blocks in enumerate(blocks):
             f = self.width * (2 ** stage)
             for b in range(n_blocks):
                 stride = 2 if (b == 0 and stage > 0) else 1
                 h = scope.child(_ResBlock(f, stride, bottleneck), h,
                                 name=f"stage{stage}_block{b}")
+            if stage >= 1:
+                taps.append(h)
+        if self.return_stages:
+            return taps
         h = jnp.mean(h, axis=(1, 2))  # global average pool
         if not self.include_top:
             return h

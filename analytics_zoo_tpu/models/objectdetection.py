@@ -83,37 +83,28 @@ class SSDLite(ZooModel):
                             image_size=image_size)
         self.class_num = class_num
         self.image_size = image_size
-        self.backbone = ResNet(depth=backbone_depth, include_top=False)
-        # feature strides 8/16/32/64 on image_size → map sizes
+        self.backbone = ResNet(depth=backbone_depth, include_top=False,
+                               return_stages=True)
+        # feature strides 8/16/32/64 on image_size → map sizes.  SAME-padded
+        # stride-2 convs produce ceil(s/stride) maps, so fm sizes must be
+        # computed by REPEATED ceil-division (floor disagrees for sizes not
+        # divisible by 64 and desyncs anchors from head outputs)
+        def halve(v: int, times: int) -> int:
+            for _ in range(times):
+                v = -(-v // 2)
+            return v
+
         s = image_size
-        self.fm_sizes = [(s // 8, s // 8), (s // 16, s // 16),
-                         (s // 32, s // 32), (s // 64, s // 64)]
+        self.fm_sizes = [(halve(s, k), halve(s, k)) for k in (3, 4, 5, 6)]
         self.scales = [0.1, 0.25, 0.45, 0.7]
         self.anchors = _make_anchors(self.fm_sizes, self.scales)
 
     def _features(self, scope: Scope, x: jax.Array) -> List[jax.Array]:
-        """Run the ResNet trunk, tapping stages 1..3 + an extra conv level."""
-        rn = self.backbone
-        from .image import _SPECS, _ResBlock
-        blocks, bottleneck = _SPECS[rn.depth]
-        h = scope.child(nn.Conv2D(rn.width, 7, strides=2, use_bias=False),
-                        x, name="stem")
-        h = scope.child(nn.BatchNormalization(), h, name="stem_bn")
-        h = jax.nn.relu(h)
-        h = scope.child(nn.MaxPooling2D(3, strides=2, padding="same"), h,
-                        name="stem_pool")
-        taps = []
-        for stage, n_blocks in enumerate(blocks):
-            f = rn.width * (2 ** stage)
-            for b in range(n_blocks):
-                stride = 2 if (b == 0 and stage > 0) else 1
-                h = scope.child(_ResBlock(f, stride, bottleneck), h,
-                                name=f"stage{stage}_block{b}")
-            if stage >= 1:
-                taps.append(h)
+        """ResNet trunk taps (stages 1..3) + one extra stride-2 level."""
+        taps = scope.child(self.backbone, x, name="backbone")
         extra = scope.child(nn.Conv2D(256, 3, strides=2, activation="relu"),
                             taps[-1], name="extra")
-        return taps + [extra]
+        return list(taps) + [extra]
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         """Returns [B, n_anchors, 4 + class_num] (loc ++ class logits)."""

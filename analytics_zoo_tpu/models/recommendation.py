@@ -227,8 +227,10 @@ def _recommend(model: ZooModel, user_ids, item_ids, per: str, k: int
     cls = probs.argmax(-1)
     results: List[UserItemPrediction] = []
     n_u, n_i = len(user_ids), len(item_ids)
-    score = probs.max(-1) * (cls != 0)  # class 0 = negative
-    grid = score.reshape(n_u, n_i)
+    # rank AND report by P(positive) = 1 - P(class 0), so a confidently
+    # negative item never surfaces with a high probability attached
+    pos_prob = 1.0 - probs[:, 0]
+    grid = pos_prob.reshape(n_u, n_i)
     if per == "user":
         for ui, u in enumerate(user_ids):
             top = np.argsort(-grid[ui])[:k]
@@ -236,7 +238,7 @@ def _recommend(model: ZooModel, user_ids, item_ids, per: str, k: int
                 idx = ui * n_i + ii
                 results.append(UserItemPrediction(
                     int(u), int(item_ids[ii]), int(cls[idx]),
-                    float(probs[idx].max())))
+                    float(pos_prob[idx])))
     else:
         for ii, it in enumerate(item_ids):
             top = np.argsort(-grid[:, ii])[:k]
@@ -244,5 +246,5 @@ def _recommend(model: ZooModel, user_ids, item_ids, per: str, k: int
                 idx = ui * n_i + ii
                 results.append(UserItemPrediction(
                     int(user_ids[ui]), int(it), int(cls[idx]),
-                    float(probs[idx].max())))
+                    float(pos_prob[idx])))
     return results

@@ -33,17 +33,19 @@ class RNNEncoder(Module):
     def forward(self, scope, x):
         if self.embedding is not None:
             x = scope.child(self.embedding, x, name="embed")
-        states = []
         for i in range(self.num_layers):
             cls = nn.LSTM if self.rnn_type == "lstm" else nn.GRU
-            layer = cls(self.hidden_size, return_sequences=True,
-                        return_state=True)
-            x, st = scope.child(layer, x, name=f"rnn_{i}")
-            states.append(st)
-        return x, states
+            x = scope.child(cls(self.hidden_size, return_sequences=True), x,
+                            name=f"rnn_{i}")
+        return x
 
 
 class RNNDecoder(Module):
+    """Stacked decoder RNN.  Context injection: the bridge's summary vector
+    arrives as the FIRST timestep of ``x`` (prepended by Seq2seq) — our RNN
+    layers are carry-free, so state is injected through the input sequence,
+    and the caller drops the first output step."""
+
     def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
                  hidden_size: int = 64, embedding: Optional[Module] = None,
                  name: Optional[str] = None):
@@ -53,12 +55,9 @@ class RNNDecoder(Module):
         self.hidden_size = hidden_size
         self.embedding = embedding
 
-    def forward(self, scope, x, init_states=None):
+    def forward(self, scope, x):
         if self.embedding is not None:
             x = scope.child(self.embedding, x, name="embed")
-        # note: init_states are folded in by re-running the cell from the
-        # provided carry — our RNN layers accept no initial state, so the
-        # bridge injects state by prepending a pseudo-step (see Seq2seq).
         for i in range(self.num_layers):
             cls = nn.LSTM if self.rnn_type == "lstm" else nn.GRU
             x = scope.child(cls(self.hidden_size, return_sequences=True), x,
@@ -93,7 +92,7 @@ class Seq2seq(ZooModel):
         embed = nn.Embedding(self.vocab_size, self.embed_dim)
         enc = RNNEncoder(self.rnn_type, self.num_layers, self.hidden_size,
                          embedding=embed)
-        enc_out, enc_states = scope.child(enc, enc_ids, name="encoder")
+        enc_out = scope.child(enc, enc_ids, name="encoder")
 
         # Bridge: map encoder summary → a context vector prepended to the
         # decoder input sequence (state injection without stateful cells)
@@ -108,10 +107,8 @@ class Seq2seq(ZooModel):
             ctx = scope.child(nn.Dense(self.embed_dim), summary,
                               name="ctx_proj")[:, None, :]
         h = jnp.concatenate([ctx, dec_in], axis=1)  # [B, 1+T_dec, E]
-        for i in range(self.num_layers):
-            cls = nn.LSTM if self.rnn_type == "lstm" else nn.GRU
-            h = scope.child(cls(self.hidden_size, return_sequences=True), h,
-                            name=f"dec_rnn_{i}")
+        dec = RNNDecoder(self.rnn_type, self.num_layers, self.hidden_size)
+        h = scope.child(dec, h, name="decoder")
         h = h[:, 1:]                                # drop the context step
         if self.use_attention:
             # Luong dot attention over encoder outputs

@@ -1,0 +1,99 @@
+"""AutoML tests (reference pattern: pyzoo/test/zoo/orca/automl)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context("local")
+    yield
+
+
+def test_hp_samplers_and_grid():
+    from analytics_zoo_tpu.automl import hp
+    rng = np.random.default_rng(0)
+    space = {"a": hp.choice([1, 2, 3]), "b": hp.uniform(0.0, 1.0),
+             "c": hp.randint(5, 10), "d": hp.loguniform(1e-4, 1e-1),
+             "e": hp.quniform(0, 10, 2), "fixed": 7}
+    for _ in range(20):
+        s = hp.sample(space, rng)
+        assert s["a"] in (1, 2, 3)
+        assert 0.0 <= s["b"] <= 1.0
+        assert 5 <= s["c"] < 10
+        assert 1e-4 <= s["d"] <= 1e-1
+        assert s["e"] % 2 == 0
+        assert s["fixed"] == 7
+    g = hp.grid({"a": hp.grid_search([1, 2]), "b": hp.choice(["x", "y"])})
+    assert len(g) == 4
+
+
+def test_random_search_finds_good_config():
+    from analytics_zoo_tpu.automl import RandomSearchEngine, hp
+
+    def trial(config, report):
+        # quadratic bowl: best at x=3
+        m = (config["x"] - 3.0) ** 2
+        report(m, 1)
+        return m
+
+    eng = RandomSearchEngine(metric_mode="min", seed=0)
+    best = eng.run(trial, {"x": hp.uniform(-10, 10)}, n_trials=40)
+    assert abs(best.config["x"] - 3.0) < 2.0
+    assert len(eng.trials) == 40
+
+
+def test_asha_prunes_bad_trials():
+    from analytics_zoo_tpu.automl import ASHAScheduler, RandomSearchEngine, hp
+
+    def trial(config, report):
+        for step in range(1, 10):
+            report(config["level"], step)
+        return config["level"]
+
+    sched = ASHAScheduler(metric_mode="min", grace_period=1,
+                          reduction_factor=3, max_t=9)
+    eng = RandomSearchEngine(metric_mode="min", scheduler=sched, seed=1)
+    best = eng.run(trial, {"level": hp.uniform(0, 1)}, n_trials=12)
+    pruned = [t for t in eng.trials if t.status == "pruned"]
+    assert len(pruned) > 0            # bad trials stopped early
+    assert best.metric == min(t.metric for t in eng.trials
+                              if t.metric is not None)
+
+
+def test_search_survives_failing_trials():
+    from analytics_zoo_tpu.automl import RandomSearchEngine, hp
+
+    def trial(config, report):
+        if config["x"] < 0:
+            raise RuntimeError("boom")
+        return config["x"]
+
+    eng = RandomSearchEngine(metric_mode="min", seed=0)
+    best = eng.run(trial, {"x": hp.uniform(-1, 1)}, n_trials=16)
+    assert best.metric is not None and best.metric >= 0
+    assert any(t.status == "error" for t in eng.trials)
+
+
+def test_auto_estimator_end_to_end(rng):
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.automl import AutoEstimator, hp
+
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    y = x @ w
+
+    def creator(config):
+        return nn.Sequential([nn.Dense(config["hidden"], activation="relu"),
+                              nn.Dense(1)])
+
+    auto = AutoEstimator.from_keras(creator, loss="mse", metric="mse")
+    auto.fit((x, y), epochs=2, batch_size=16, n_sampling=3,
+             search_space={"hidden": hp.choice([4, 8]),
+                           "lr": hp.choice([1e-2, 1e-3])})
+    cfg = auto.get_best_config()
+    assert cfg["hidden"] in (4, 8)
+    est = auto.get_best_estimator()
+    assert est.evaluate((x, y), batch_size=16)["mse"] < 10.0

@@ -1,0 +1,208 @@
+"""Chronos tests (reference pattern: pyzoo/test/zoo/chronos — synthetic
+random-walk series generated in the test file)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context("local")
+    yield
+
+
+def _series_df(n=200, freq="h", seed=0):
+    rng = np.random.default_rng(seed)
+    ts = pd.date_range("2021-01-01", periods=n, freq=freq)
+    value = np.sin(np.arange(n) / 12) + 0.1 * rng.normal(size=n)
+    return pd.DataFrame({"datetime": ts, "value": value,
+                         "extra": rng.normal(size=n)})
+
+
+# -- TSDataset ----------------------------------------------------------------
+
+def test_tsdataset_roll_shapes():
+    from analytics_zoo_tpu.chronos import TSDataset
+    ts = TSDataset.from_pandas(_series_df(), dt_col="datetime",
+                               target_col="value",
+                               extra_feature_col=["extra"])
+    ts.roll(lookback=24, horizon=4)
+    x, y = ts.to_numpy()
+    assert x.shape == (200 - 24 - 4 + 1, 24, 2)
+    assert y.shape == (200 - 24 - 4 + 1, 4, 1)
+    # y windows follow the x windows
+    np.testing.assert_allclose(y[0, 0, 0], ts.df["value"].iloc[24])
+
+
+def test_tsdataset_impute_dedup_resample():
+    from analytics_zoo_tpu.chronos import TSDataset
+    df = _series_df(50)
+    df.loc[5, "value"] = np.nan
+    df = pd.concat([df, df.iloc[[10]]])  # duplicate timestamp
+    ts = TSDataset.from_pandas(df, dt_col="datetime", target_col="value",
+                               extra_feature_col=["extra"])
+    ts.deduplicate().impute(mode="linear")
+    assert len(ts.df) == 50
+    assert not ts.df["value"].isna().any()
+    ts.resample("2h")
+    assert len(ts.df) == 25
+
+
+def test_tsdataset_scale_roundtrip():
+    from analytics_zoo_tpu.chronos import TSDataset
+    ts = TSDataset.from_pandas(_series_df(), dt_col="datetime",
+                               target_col="value",
+                               extra_feature_col=["extra"])
+    raw = ts.df["value"].to_numpy().copy()
+    ts.scale("standard")
+    assert abs(ts.df["value"].mean()) < 1e-6
+    ts.roll(lookback=10, horizon=1)
+    _, y = ts.to_numpy()
+    unscaled = ts.unscale_numpy(y)
+    np.testing.assert_allclose(unscaled[:, 0, 0], raw[10:], rtol=1e-5)
+
+
+def test_tsdataset_dt_features_and_split():
+    from analytics_zoo_tpu.chronos import TSDataset
+    train, val, test = TSDataset.from_pandas(
+        _series_df(100), dt_col="datetime", target_col="value",
+        with_split=True, val_ratio=0.1, test_ratio=0.1)
+    assert len(train.df) == 80 and len(val.df) == 10 and len(test.df) == 10
+    train.gen_dt_feature(["HOUR", "IS_WEEKEND"])
+    assert "HOUR" in train.df.columns
+    assert "HOUR" in train.feature_col
+
+
+# -- forecasters --------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["lstm", "seq2seq", "tcn"])
+def test_forecasters_fit_predict_save_load(name, tmp_path):
+    from analytics_zoo_tpu.chronos import (LSTMForecaster, Seq2SeqForecaster,
+                                           TCNForecaster, TSDataset)
+    cls = {"lstm": LSTMForecaster, "seq2seq": Seq2SeqForecaster,
+           "tcn": TCNForecaster}[name]
+    ts = TSDataset.from_pandas(_series_df(), dt_col="datetime",
+                               target_col="value")
+    fc = cls.from_tsdataset(ts, past_seq_len=16, future_seq_len=2)
+    hist = fc.fit(epochs=2, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0] * 2  # trains, no blow-up
+    x, y = ts.to_numpy()
+    pred = fc.predict(x[:8])
+    assert pred.shape == (8, 2, 1)
+    res = fc.evaluate((x, y))
+    assert np.isfinite(res["mse"])
+    path = str(tmp_path / name)
+    fc.save(path)
+    fc2 = cls(past_seq_len=16, future_seq_len=2, input_feature_num=1,
+              output_feature_num=1)
+    fc2.load(path)
+    np.testing.assert_allclose(fc2.predict(x[:8]), pred, atol=1e-5)
+
+
+def test_tcn_forecaster_actually_learns():
+    from analytics_zoo_tpu.chronos import TCNForecaster, TSDataset
+    ts = TSDataset.from_pandas(_series_df(400, seed=3), dt_col="datetime",
+                               target_col="value")
+    fc = TCNForecaster.from_tsdataset(ts, past_seq_len=24, future_seq_len=1,
+                                      lr=5e-3)
+    fc.fit(epochs=8, batch_size=64)
+    x, y = ts.to_numpy()
+    pred = fc.predict(x)
+    mse = float(np.mean((pred - y) ** 2))
+    var = float(np.var(y))
+    assert mse < var * 0.5  # beats the mean predictor comfortably
+
+
+# -- detectors ----------------------------------------------------------------
+
+def test_threshold_detector():
+    from analytics_zoo_tpu.chronos import ThresholdDetector
+    y = np.zeros(100)
+    y[37] = 10.0
+    det = ThresholdDetector(ratio=0.02)
+    idx = det.anomaly_indexes(y)
+    assert 37 in idx
+
+
+def test_ae_detector():
+    from analytics_zoo_tpu.chronos import AEDetector
+    rng = np.random.default_rng(0)
+    y = np.sin(np.arange(300) / 5) + 0.01 * rng.normal(size=300)
+    y[200] += 8.0
+    det = AEDetector(roll_len=12, ratio=0.02, epochs=5)
+    idx = det.anomaly_indexes(y)
+    assert any(195 <= i <= 205 for i in idx)
+
+
+def test_dbscan_detector():
+    from analytics_zoo_tpu.chronos import DBScanDetector
+    y = np.concatenate([np.random.default_rng(0).normal(0, 0.1, 100), [5.0]])
+    idx = DBScanDetector(eps=0.3, min_samples=3).anomaly_indexes(y)
+    assert 100 in idx
+
+
+# -- AutoTS -------------------------------------------------------------------
+
+def test_autots_search_and_pipeline(tmp_path):
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset, TSPipeline
+    ts = TSDataset.from_pandas(_series_df(240), dt_col="datetime",
+                               target_col="value")
+    auto = AutoTSEstimator(model=["lstm", "tcn"],
+                           search_space={"lr": hp.choice([1e-2, 1e-3])},
+                           past_seq_len=hp.choice([8, 16]),
+                           future_seq_len=1, seed=0)
+    pipeline = auto.fit(ts, epochs=2, batch_size=32, n_sampling=3)
+    cfg = auto.get_best_config()
+    assert cfg["model"] in ("lstm", "tcn")
+    ts.roll(pipeline.config["past_seq_len"], 1)
+    x, y = ts.to_numpy()
+    pred = pipeline.predict(x[:4])
+    assert pred.shape == (4, 1, 1)
+    path = str(tmp_path / "pipeline")
+    pipeline.save(path)
+    loaded = TSPipeline.load(path)
+    np.testing.assert_allclose(loaded.predict(x[:4]), pred, atol=1e-5)
+
+
+def test_tsdataset_multi_id_roll_does_not_span_series():
+    """Windows must not cross id boundaries (regression)."""
+    from analytics_zoo_tpu.chronos import TSDataset
+    ts1 = _series_df(50, seed=1).assign(station="a")
+    ts2 = _series_df(50, seed=2).assign(station="b")
+    df = pd.concat([ts1, ts2])
+    ts = TSDataset.from_pandas(df, dt_col="datetime", target_col="value",
+                               id_col="station")
+    ts.roll(lookback=10, horizon=1)
+    x, y = ts.to_numpy()
+    # per-id: 50 - 10 - 1 + 1 = 40 windows each
+    assert x.shape[0] == 80
+    # first window of series b must equal rolling b alone
+    tsb = TSDataset.from_pandas(ts2, dt_col="datetime", target_col="value")
+    tsb.roll(lookback=10, horizon=1)
+    xb, _ = tsb.to_numpy()
+    np.testing.assert_allclose(x[40], xb[0])
+
+
+def test_tspipeline_save_preserves_model_kwargs(tmp_path):
+    """model_kwargs (searched architecture) must survive save/load
+    (regression)."""
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset, TSPipeline
+    ts = TSDataset.from_pandas(_series_df(120), dt_col="datetime",
+                               target_col="value")
+    auto = AutoTSEstimator(model=["lstm"],
+                           search_space={"hidden_dim": hp.choice([16])},
+                           past_seq_len=8, future_seq_len=1)
+    pipe = auto.fit(ts, epochs=1, batch_size=16, n_sampling=1)
+    path = str(tmp_path / "p")
+    pipe.save(path)
+    loaded = TSPipeline.load(path)
+    assert loaded.config["model_kwargs"]["hidden_dim"] == 16
+    ts.roll(8, 1)
+    x, _ = ts.to_numpy()
+    np.testing.assert_allclose(loaded.predict(x[:2]), pipe.predict(x[:2]),
+                               atol=1e-5)

@@ -207,3 +207,52 @@ def test_bert_classifier_and_squad(rng):
                      ).astype(np.int32)
     hist = sq.fit((x, spans), epochs=1, batch_size=8, verbose=False)
     assert np.isfinite(hist["loss"][0])
+
+
+def test_load_model_then_plain_compile_keeps_weights(rng, tmp_path):
+    """compile() after load_model must start from loaded weights
+    (regression: silently re-initialized)."""
+    from analytics_zoo_tpu.models import NeuralCF, ZooModel
+    m = NeuralCF(user_count=10, item_count=10, hidden_layers=(8,))
+    m.compile(loss="sparse_categorical_crossentropy")
+    x = np.stack([rng.integers(0, 10, 32), rng.integers(0, 10, 32)], 1
+                 ).astype(np.int32)
+    y = rng.integers(0, 2, 32).astype(np.int32)
+    m.fit((x, y), epochs=1, batch_size=16, verbose=False)
+    p1 = m.predict(x)
+    path = str(tmp_path / "m")
+    m.save_model(path)
+    m2 = ZooModel.load_model(path)
+    m2.compile(loss="sparse_categorical_crossentropy")  # plain compile
+    np.testing.assert_allclose(m2.predict(x), p1, atol=1e-6)
+
+
+def test_ssd_anchor_count_matches_head_for_odd_sizes(rng):
+    """image_size not divisible by 64 must still align anchors with the
+    head output (regression: floor-vs-ceil feature map sizes)."""
+    from analytics_zoo_tpu.models import SSDLite
+    m = SSDLite(class_num=3, backbone_depth=18, image_size=100)
+    m.compile(loss="mse")
+    imgs = rng.normal(size=(1, 100, 100, 3)).astype(np.float32)
+    raw = m.predict(imgs)
+    assert raw.shape[1] == len(m.anchors)
+
+
+def test_recommend_probability_is_positive_class(rng):
+    """UserItemPrediction.probability must be P(recommend), not the max
+    class prob (regression: confident negatives surfaced as top picks)."""
+    from analytics_zoo_tpu.models import NeuralCF
+    m = NeuralCF(user_count=8, item_count=8, hidden_layers=(4,))
+    m.compile(loss="sparse_categorical_crossentropy")
+    x = np.stack([rng.integers(0, 8, 16), rng.integers(0, 8, 16)], 1
+                 ).astype(np.int32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    m.fit((x, y), epochs=1, batch_size=16, verbose=False)
+    recs = m.recommend_for_user([0], max_items=8)
+    pairs = np.stack([np.zeros(8), np.arange(8)], 1).astype(np.int32)
+    import jax.nn
+    import jax.numpy as jnp
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(m.predict(pairs)), -1))
+    for r in recs:
+        np.testing.assert_allclose(r.probability, 1 - probs[r.item_id, 0],
+                                   atol=1e-6)
