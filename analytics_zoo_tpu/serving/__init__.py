@@ -1,0 +1,16 @@
+"""Serving & inference (reference: SURVEY.md §2.8 — InferenceModel,
+Cluster Serving's Flink/Redis pipeline, the akka-HTTP frontend, and the
+Python InputQueue/OutputQueue client).
+
+TPU-native collapse: the Flink job + Redis transport + JNI model pool
+become one process — an AOT-compiled XLA executable behind a native
+(C++ queue) micro-batching loop, served over a lightweight TCP protocol.
+Client semantics are preserved: ``InputQueue.enqueue`` → uuid,
+``OutputQueue.query(uuid)`` → ndarray.
+"""
+
+from .inference_model import InferenceModel
+from .server import ClusterServing
+from .client import InputQueue, OutputQueue
+
+__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue"]

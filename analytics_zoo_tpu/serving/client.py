@@ -1,0 +1,107 @@
+"""Serving client (reference: pyzoo/zoo/serving/client.py — InputQueue
+pushed b64-Arrow ndarrays into Redis, OutputQueue polled result keys).
+
+Same two-class API over the TCP frame protocol; one connection carries both
+directions, results are matched by uuid.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import uuid as uuid_mod
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from . import protocol
+
+
+class _Conn:
+    """Shared connection + background reader demuxing replies by uuid."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str]]]
+        self._results = {}
+        self._cond = threading.Condition()
+        self._send_lock = threading.Lock()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = protocol.recv_frame(self.sock)
+                if frame is None:
+                    return
+                header, arr = protocol.decode(frame)
+                with self._cond:
+                    self._results[header["uuid"]] = (arr,
+                                                     header.get("error"))
+                    self._cond.notify_all()
+        except OSError:
+            pass
+
+    def send(self, header, arr) -> None:
+        with self._send_lock:
+            protocol.send_frame(self.sock, protocol.encode(header, arr))
+
+    def wait(self, uid: str, timeout: Optional[float]
+             ) -> Optional[Tuple[Optional[np.ndarray], Optional[str]]]:
+        with self._cond:
+            ok = self._cond.wait_for(lambda: uid in self._results,
+                                     timeout=timeout)
+            if not ok:
+                return None
+            return self._results.pop(uid)
+
+    def peek(self, uid: str):
+        with self._cond:
+            return self._results.pop(uid, None)
+
+
+class InputQueue:
+    """``enqueue(name, t=ndarray)`` → uuid (reference API shape)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8980,
+                 frontend_url: Optional[str] = None):
+        if frontend_url:  # "host:port" parity with the reference's url conf
+            host, port_s = frontend_url.rsplit(":", 1)
+            port = int(port_s)
+        self._conn = _Conn(host, port)
+
+    def enqueue(self, name: str, **kwargs: np.ndarray) -> str:
+        if len(kwargs) != 1:
+            raise ValueError("exactly one named tensor per enqueue "
+                             "(reference: t=ndarray)")
+        (_, arr), = kwargs.items()
+        uid = f"{name}-{uuid_mod.uuid4()}"
+        self._conn.send({"uuid": uid},
+                        np.asarray(arr))
+        return uid
+
+    @property
+    def conn(self) -> _Conn:
+        return self._conn
+
+
+class OutputQueue:
+    """``query(uuid)`` / ``dequeue()`` (reference API shape)."""
+
+    def __init__(self, input_queue: Optional[InputQueue] = None,
+                 host: str = "127.0.0.1", port: int = 8980):
+        if input_queue is not None:
+            self._conn = input_queue.conn
+        else:
+            self._conn = _Conn(host, port)
+
+    def query(self, uid: str, timeout: Optional[float] = 30.0
+              ) -> Optional[np.ndarray]:
+        res = self._conn.wait(uid, timeout)
+        if res is None:
+            return None
+        arr, err = res
+        if err:
+            raise RuntimeError(f"serving error for {uid}: {err}")
+        return arr

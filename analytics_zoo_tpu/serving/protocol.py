@@ -1,0 +1,62 @@
+"""Wire protocol for ClusterServing: length-prefixed msgpack-free frames.
+
+Frame = 4-byte big-endian length + payload.  Payload = header json (utf-8)
++ b"\\0" + raw ndarray bytes.  Replaces the reference's
+ndarray→Arrow→base64→Redis encoding (pyzoo/zoo/serving/client.py) with a
+single-copy binary framing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def encode(header: Dict[str, Any], arr: Optional[np.ndarray] = None) -> bytes:
+    if arr is not None:
+        header = dict(header, dtype=str(arr.dtype), shape=list(arr.shape))
+        body = np.ascontiguousarray(arr).tobytes()
+    else:
+        body = b""
+    head = json.dumps(header).encode()
+    payload = head + b"\0" + body
+    return struct.pack(">I", len(payload)) + payload
+
+
+def decode(payload: bytes) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+    sep = payload.index(b"\0")
+    header = json.loads(payload[:sep].decode())
+    body = payload[sep + 1:]
+    arr = None
+    if "dtype" in header:
+        arr = np.frombuffer(body, dtype=header["dtype"]).reshape(
+            header["shape"])
+    return header, arr
+
+
+def send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    raw_len = _recv_exact(sock, 4)
+    if raw_len is None:
+        return None
+    (length,) = struct.unpack(">I", raw_len)
+    return _recv_exact(sock, length)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

@@ -1,0 +1,188 @@
+"""ClusterServing: the always-on inference service.
+
+Reference (SURVEY.md §2.8/§3.5): a Flink streaming job polled Redis
+(`serving_stream`), batched records, ran InferenceModel through JNI
+(OpenVINO/TF/BigDL), and wrote results back to per-key Redis entries; an
+akka-HTTP frontend fed the same queue.
+
+TPU-native redesign: one process, three stages —
+  1. a TCP acceptor thread per connection parses frames and pushes requests
+     onto a NATIVE C++ bounded queue (the Redis-list equivalent);
+  2. a batcher thread pops up to ``batch_size`` requests (or ``timeout_ms``),
+     stacks them, and runs the AOT-compiled InferenceModel once;
+  3. results are delivered back over the same connection, keyed by the
+     client-supplied uuid (OutputQueue.query matches on it).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid as uuid_mod
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.native import NativeQueue
+from .inference_model import InferenceModel
+from . import protocol
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class _Pending:
+    __slots__ = ("uuid", "arr", "conn", "lock")
+
+    def __init__(self, uid: str, arr: np.ndarray, conn: socket.socket,
+                 lock: threading.Lock):
+        self.uuid = uid
+        self.arr = arr
+        self.conn = conn
+        self.lock = lock
+
+
+class ClusterServing:
+    """config parity with the reference's config.yaml: model + batch size +
+    address (the Redis url's slot)."""
+
+    def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
+                 port: int = 0, batch_size: int = 16,
+                 batch_timeout_ms: int = 5, queue_items: int = 4096):
+        self.model = model
+        self.batch_size = batch_size
+        self.batch_timeout_ms = batch_timeout_ms
+        self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
+        self._pending: Dict[int, _Pending] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "ClusterServing":
+        t_accept = threading.Thread(target=self._accept_loop, daemon=True)
+        t_batch = threading.Thread(target=self._batch_loop, daemon=True)
+        t_accept.start()
+        t_batch.start()
+        self._threads = [t_accept, t_batch]
+        logger.info("ClusterServing listening on %s:%d (batch=%d, native "
+                    "queue=%s)", self.host, self.port, self.batch_size,
+                    self._queue.is_native)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- stage 1: accept + parse ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                frame = protocol.recv_frame(conn)
+                if frame is None:
+                    return
+                header, arr = protocol.decode(frame)
+                uid = header.get("uuid") or str(uuid_mod.uuid4())
+                with self._pending_lock:
+                    rid = self._next_id
+                    self._next_id += 1
+                    self._pending[rid] = _Pending(uid, arr, conn, send_lock)
+                ok = self._queue.push(rid.to_bytes(8, "big"), timeout=5.0)
+                if not ok:  # back-pressure: reject instead of dropping
+                    with self._pending_lock:
+                        self._pending.pop(rid, None)
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "error": "queue full"}))
+        except (OSError, ValueError) as e:
+            logger.debug("connection closed: %s", e)
+        finally:
+            conn.close()
+
+    # -- stage 2: batch + infer ----------------------------------------------
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch: List[_Pending] = []
+            try:
+                item = self._queue.pop(timeout=0.5)
+            except RuntimeError:
+                return
+            if item is None:
+                continue
+            batch.append(self._take(item[0]))
+            deadline = time.time() + self.batch_timeout_ms / 1000.0
+            while len(batch) < self.batch_size:
+                left = deadline - time.time()
+                if left <= 0:
+                    break
+                try:
+                    item = self._queue.pop(timeout=left)
+                except RuntimeError:
+                    break
+                if item is None:
+                    break
+                batch.append(self._take(item[0]))
+            batch = [p for p in batch if p is not None]
+            if not batch:
+                continue
+            self._run_batch(batch)
+
+    def _take(self, rid_bytes: bytes) -> Optional[_Pending]:
+        rid = int.from_bytes(rid_bytes, "big")
+        with self._pending_lock:
+            return self._pending.pop(rid, None)
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        # group by input shape (mixed-shape requests can't stack)
+        groups: Dict[Tuple, List[_Pending]] = {}
+        for p in batch:
+            groups.setdefault(tuple(p.arr.shape) + (str(p.arr.dtype),),
+                              []).append(p)
+        for _, group in groups.items():
+            x = np.stack([p.arr for p in group])
+            try:
+                out = self.model.predict(x)
+                for p, row in zip(group, out):
+                    self._reply(p, {"uuid": p.uuid}, row)
+            except Exception as e:  # noqa: BLE001 — report to the client
+                logger.warning("inference failed: %s", e)
+                for p in group:
+                    self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
+
+    def _reply(self, p: _Pending, header: Dict[str, Any],
+               arr: Optional[np.ndarray]) -> None:
+        try:
+            with p.lock:
+                protocol.send_frame(p.conn, protocol.encode(header, arr))
+        except OSError:
+            pass  # client went away
