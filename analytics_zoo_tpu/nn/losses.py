@@ -17,7 +17,9 @@ import jax.numpy as jnp
 def sparse_categorical_crossentropy(y_pred: jax.Array, y_true: jax.Array,
                                     from_logits: bool = True) -> jax.Array:
     if from_logits:
-        logp = jax.nn.log_softmax(y_pred, axis=-1)
+        # mixed-precision recipe: matmuls in bf16, softmax/log in f32 (the
+        # cast fuses into the reduction; bf16 log_softmax loses ~3 digits)
+        logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
     else:
         logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
     y_true = y_true.astype(jnp.int32)
@@ -28,7 +30,7 @@ def sparse_categorical_crossentropy(y_pred: jax.Array, y_true: jax.Array,
 def categorical_crossentropy(y_pred: jax.Array, y_true: jax.Array,
                              from_logits: bool = True) -> jax.Array:
     if from_logits:
-        logp = jax.nn.log_softmax(y_pred, axis=-1)
+        logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
     else:
         logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
     return -(y_true * logp).sum(axis=-1).mean()
