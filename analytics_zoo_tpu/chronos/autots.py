@@ -24,19 +24,56 @@ _MODELS = {"lstm": LSTMForecaster, "seq2seq": Seq2SeqForecaster,
            "tcn": TCNForecaster}
 
 
-class TSPipeline:
-    """fitted forecaster + the tsdata scaler: predict/evaluate/save/load."""
+def _target_scaler(tsdata) -> Optional[Dict[str, Any]]:
+    """Compact, json-able slice of a TSDataset scaler covering the target
+    columns only (what predictions need for unscaling)."""
+    s = getattr(tsdata, "scaler", None)
+    if s is None:
+        return None
+    cols = tsdata.target_col
+    if s["type"] == "standard":
+        return {"type": "standard",
+                "mean": [float(v) for v in s["mean"][cols]],
+                "std": [float(v) for v in s["std"][cols]]}
+    return {"type": "minmax",
+            "min": [float(v) for v in s["min"][cols]],
+            "range": [float(v) for v in s["range"][cols]]}
 
-    def __init__(self, forecaster, config: Dict[str, Any], scaler=None):
+
+class TSPipeline:
+    """Fitted forecaster + the fitted target scaler: predict/evaluate/save/
+    load.  Predictions are returned in the ORIGINAL (unscaled) space when a
+    scaler is present, matching the reference TSPipeline (SURVEY.md §2.6)."""
+
+    def __init__(self, forecaster, config: Dict[str, Any],
+                 scaler: Optional[Dict[str, Any]] = None):
         self.forecaster = forecaster
         self.config = config
         self.scaler = scaler
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        return self.forecaster.predict(x)
+    def _unscale(self, arr: np.ndarray) -> np.ndarray:
+        s = self.scaler
+        if s is None:
+            return arr
+        if s["type"] == "standard":
+            return arr * np.asarray(s["std"]) + np.asarray(s["mean"])
+        return arr * np.asarray(s["range"]) + np.asarray(s["min"])
+
+    def predict(self, x: np.ndarray, unscale: bool = True) -> np.ndarray:
+        pred = self.forecaster.predict(x)
+        return self._unscale(pred) if unscale else pred
 
     def evaluate(self, data) -> Dict[str, float]:
-        return self.forecaster.evaluate(data)
+        """Metrics in the original space when a scaler is present (x and y
+        are still expected in the scaled space the model was trained on)."""
+        if self.scaler is None:
+            return self.forecaster.evaluate(data)
+        x, y = data.to_numpy() if hasattr(data, "to_numpy") else data
+        pred = self.predict(x)
+        truth = self._unscale(np.asarray(y))
+        err = pred - truth
+        return {"mse": float(np.mean(err ** 2)),
+                "mae": float(np.mean(np.abs(err)))}
 
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
@@ -52,15 +89,18 @@ class TSPipeline:
                            for k, x in v.items())
             return False
 
+        payload = {k: v for k, v in self.config.items() if jsonable(v)}
+        if self.scaler is not None:
+            payload["__scaler__"] = self.scaler
         with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump({k: v for k, v in self.config.items() if jsonable(v)},
-                      f)
+            json.dump(payload, f)
         return path
 
     @staticmethod
     def load(path: str) -> "TSPipeline":
         with open(os.path.join(path, "config.json")) as f:
             config = json.load(f)
+        scaler = config.pop("__scaler__", None)
         model_cls = _MODELS[config["model"]]
         fc = model_cls(
             past_seq_len=config["past_seq_len"],
@@ -70,7 +110,7 @@ class TSPipeline:
             **config.get("model_kwargs", {}))
         # initialize then load weights
         fc.est.load(os.path.join(path, "model"))
-        return TSPipeline(fc, config)
+        return TSPipeline(fc, config, scaler=scaler)
 
 
 class AutoTSEstimator:
@@ -164,7 +204,7 @@ class AutoTSEstimator:
                                  if k not in ("model", "past_seq_len", "lr",
                                               "batch_size")})
         return TSPipeline(fc, cfg,
-                          scaler=getattr(data, "scaler", None))
+                          scaler=_target_scaler(data) if is_tsdata else None)
 
     def get_best_config(self) -> Dict[str, Any]:
         if self.best_config is None:

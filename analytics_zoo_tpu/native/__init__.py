@@ -123,7 +123,7 @@ class NativeQueue:
                 rc = self._lib.zn_queue_pop(
                     self._q, buf, len(buf), ctypes.byref(tag),
                     -1 if timeout is None else int(timeout * 1000))
-                if rc == 0:
+                if rc == -3:        # distinct from a popped empty payload
                     return None
                 if rc == -2:
                     raise RuntimeError("queue closed")

@@ -95,8 +95,12 @@ size_t zn_queue_next_size(void* qp) {
   return q->items.empty() ? 0 : q->items.front().data.size();
 }
 
-// Pop into caller buffer.  Returns payload size, 0 on timeout, -2 closed+empty.
-// If the buffer is too small the item stays queued and -(needed) is returned.
+// Pop into caller buffer.  Returns payload size (>= 0), -3 on timeout,
+// -2 closed+empty.  A zero-length payload is a valid pop (returns 0), which
+// is why timeout has its own code.  If the buffer is too small the item
+// stays queued and -(needed) is returned (callers retry with a bigger
+// buffer; needed is always > buflen >= 4, so it cannot collide with
+// -2/-3).
 long long zn_queue_pop(void* qp, uint8_t* buf, size_t buflen, uint64_t* tag,
                        int timeout_ms) {
   auto* q = static_cast<Queue*>(qp);
@@ -106,7 +110,7 @@ long long zn_queue_pop(void* qp, uint8_t* buf, size_t buflen, uint64_t* tag,
     q->not_empty.wait(lk, has_item);
   } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                                     has_item)) {
-    return 0;
+    return -3;
   }
   if (q->items.empty()) return -2;  // closed and drained
   Item& it = q->items.front();

@@ -12,5 +12,7 @@ Client semantics are preserved: ``InputQueue.enqueue`` → uuid,
 from .inference_model import InferenceModel
 from .server import ClusterServing
 from .client import InputQueue, OutputQueue
+from .http_frontend import HTTPFrontend
 
-__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue"]
+__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue",
+           "HTTPFrontend"]

@@ -20,8 +20,17 @@ from . import protocol
 class _Conn:
     """Shared connection + background reader demuxing replies by uuid."""
 
+    #: replies for abandoned uuids (query timed out before the server
+    #: answered) are evicted oldest-first beyond this bound
+    MAX_UNCLAIMED = 1024
+
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        # the timeout bounds connect only; left on the socket it would kill
+        # the background reader after any 30s idle gap (recv raises, thread
+        # exits, every later query returns None)
+        self.sock.settimeout(None)
+        # insertion-ordered (dicts are), so eviction drops the oldest
         self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str]]]
         self._results = {}
         self._cond = threading.Condition()
@@ -39,7 +48,15 @@ class _Conn:
                 with self._cond:
                     self._results[header["uuid"]] = (arr,
                                                      header.get("error"))
+                    while len(self._results) > self.MAX_UNCLAIMED:
+                        self._results.pop(next(iter(self._results)))
                     self._cond.notify_all()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
         except OSError:
             pass
 
@@ -80,6 +97,9 @@ class InputQueue:
         self._conn.send({"uuid": uid},
                         np.asarray(arr))
         return uid
+
+    def close(self) -> None:
+        self._conn.close()
 
     @property
     def conn(self) -> _Conn:

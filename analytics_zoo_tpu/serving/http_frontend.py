@@ -1,0 +1,145 @@
+"""HTTP/JSON frontend for ClusterServing.
+
+Reference (SURVEY.md §2.8): the akka-http gateway
+(zoo/.../serving/http/FrontEndApp) accepted JSON/image POSTs, encoded them
+into the Redis queue, awaited the result key, and responded.
+
+TPU-native: a stdlib ThreadingHTTPServer that rides the SAME data path as
+binary clients — each request is enqueued over the TCP protocol
+(InputQueue), awaited by uuid (OutputQueue), and returned as JSON.  The
+frontend therefore shares the native queue, the micro-batcher, and the AOT
+executables with every other client instead of owning a second inference
+path.
+
+Endpoints (TF-Serving-flavored JSON):
+  POST /predict   {"instances": <nested list>, "dtype": "float32"?}
+                  → {"predictions": <nested list>}
+  GET  /health    → {"status": "ok"}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .client import InputQueue, OutputQueue
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class HTTPFrontend:
+    """HTTP gateway in front of a running ClusterServing's TCP port."""
+
+    def __init__(self, serving_host: str = "127.0.0.1",
+                 serving_port: int = 8980, host: str = "127.0.0.1",
+                 port: int = 0, query_timeout: float = 30.0):
+        self._serving_addr = (serving_host, serving_port)
+        self._conn_lock = threading.Lock()
+        self._connect()
+        self.query_timeout = query_timeout
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                logger.debug("http: " + fmt, *args)
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/", "/health"):
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    arr = np.asarray(req["instances"],
+                                     dtype=req.get("dtype", "float32"))
+                except (KeyError, ValueError, TypeError) as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    out = frontend.predict(arr)
+                except RuntimeError as e:  # serving-side error reply
+                    self._json(500, {"error": str(e)})
+                    return
+                except OSError as e:  # backend unreachable even after retry
+                    self._json(503, {"error": f"serving unreachable: {e}"})
+                    return
+                if out is None:
+                    self._json(504, {"error": "serving timed out"})
+                    return
+                self._json(200, {"predictions": out.tolist()})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def _connect(self) -> None:
+        self._in = InputQueue(*self._serving_addr)
+        self._out = OutputQueue(input_queue=self._in)
+
+    def _reconnect(self) -> None:
+        with self._conn_lock:
+            old = self._in
+            self._connect()
+            old.close()
+
+    def predict(self, arr: np.ndarray) -> Optional[np.ndarray]:
+        """One request through the shared connection; if the backend went
+        away (ClusterServing restart), reconnect once and retry.
+
+        A dead TCP peer is NOT reliably visible on send (the first write
+        after a remote close succeeds), so liveness is judged by the
+        connection's reader thread: it exits exactly when the server closes
+        its end."""
+        if not self._in.conn._reader.is_alive():
+            self._reconnect()  # raises OSError if the backend is still down
+        try:
+            uid = self._in.enqueue("http", t=arr)
+        except OSError:
+            self._reconnect()
+            uid = self._in.enqueue("http", t=arr)
+        out = self._out.query(uid, timeout=self.query_timeout)
+        if out is None and not self._in.conn._reader.is_alive():
+            # the send landed on a dying socket; one retry on a fresh one
+            self._reconnect()
+            uid = self._in.enqueue("http", t=arr)
+            out = self._out.query(uid, timeout=self.query_timeout)
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "HTTPFrontend":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("HTTPFrontend listening on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._in.close()  # the backend socket + its reader thread
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

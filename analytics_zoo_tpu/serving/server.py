@@ -49,10 +49,12 @@ class ClusterServing:
 
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
                  port: int = 0, batch_size: int = 16,
-                 batch_timeout_ms: int = 5, queue_items: int = 4096):
+                 batch_timeout_ms: int = 5, queue_items: int = 4096,
+                 push_timeout: float = 5.0):
         self.model = model
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
+        self.push_timeout = push_timeout  # how long accept blocks when full
         self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
         self._pending: Dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
@@ -81,6 +83,13 @@ class ClusterServing:
     def stop(self) -> None:
         self._stop.set()
         self._queue.close()
+        try:
+            # close() alone does NOT wake a thread blocked in accept() on
+            # Linux — the blocked accept keeps the socket alive in LISTEN
+            # and the port stays bound; shutdown() interrupts it
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -112,11 +121,20 @@ class ClusterServing:
                     return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())
+                if arr is None:
+                    # protocol-legal but not servable: a header-only frame
+                    # has no tensor to batch — reject here rather than let
+                    # it poison the batcher thread
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "error": "no tensor in request"}))
+                    continue
                 with self._pending_lock:
                     rid = self._next_id
                     self._next_id += 1
                     self._pending[rid] = _Pending(uid, arr, conn, send_lock)
-                ok = self._queue.push(rid.to_bytes(8, "big"), timeout=5.0)
+                ok = self._queue.push(rid.to_bytes(8, "big"),
+                                      timeout=self.push_timeout)
                 if not ok:  # back-pressure: reject instead of dropping
                     with self._pending_lock:
                         self._pending.pop(rid, None)
@@ -125,6 +143,8 @@ class ClusterServing:
                             {"uuid": uid, "error": "queue full"}))
         except (OSError, ValueError) as e:
             logger.debug("connection closed: %s", e)
+        except RuntimeError:
+            pass  # queue closed: server is stopping
         finally:
             conn.close()
 
@@ -155,7 +175,12 @@ class ClusterServing:
             batch = [p for p in batch if p is not None]
             if not batch:
                 continue
-            self._run_batch(batch)
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — batcher must survive
+                logger.warning("batch failed: %s", e)
+                for p in batch:
+                    self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
 
     def _take(self, rid_bytes: bytes) -> Optional[_Pending]:
         rid = int.from_bytes(rid_bytes, "big")

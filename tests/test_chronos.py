@@ -206,3 +206,32 @@ def test_tspipeline_save_preserves_model_kwargs(tmp_path):
     x, _ = ts.to_numpy()
     np.testing.assert_allclose(loaded.predict(x[:2]), pipe.predict(x[:2]),
                                atol=1e-5)
+
+
+def test_tspipeline_unscales_predictions(tmp_path):
+    """ADVICE r1 (low): a scaled TSDataset's pipeline must return forecasts
+    in the ORIGINAL space, and the scaler must survive save/load."""
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset, TSPipeline
+    df = _series_df(120)
+    df["value"] = df["value"] * 100.0 + 500.0  # far from scaled space
+    ts = TSDataset.from_pandas(df, dt_col="datetime", target_col="value")
+    ts.scale("standard")
+    auto = AutoTSEstimator(model=["lstm"], past_seq_len=8, future_seq_len=1)
+    pipe = auto.fit(ts, epochs=1, batch_size=16, n_sampling=1)
+    assert pipe.scaler is not None and pipe.scaler["type"] == "standard"
+    ts.roll(8, 1)
+    x, y = ts.to_numpy()
+    pred = pipe.predict(x[:4])
+    # unscaled forecasts live near the original magnitude (~500), far from
+    # the model's scaled output range (|v| ~ 1)
+    assert np.abs(pred).mean() > 50
+    np.testing.assert_allclose(pipe.predict(x[:4], unscale=False),
+                               (pred - pipe.scaler["mean"][0]) /
+                               pipe.scaler["std"][0], rtol=1e-4)
+    path = str(tmp_path / "p")
+    pipe.save(path)
+    loaded = TSPipeline.load(path)
+    assert loaded.scaler == pipe.scaler
+    np.testing.assert_allclose(loaded.predict(x[:4]), pred, atol=1e-4)
+    m = loaded.evaluate((x[:8], y[:8]))
+    assert "mse" in m and np.isfinite(m["mse"])

@@ -1,0 +1,248 @@
+"""Serving stack end-to-end: InferenceModel, ClusterServing over loopback,
+client queues, error paths, backpressure, and the HTTP frontend.
+
+Reference test strategy (SURVEY.md §4.3): serving pre/post-processing and
+engine specs ran on a Flink MiniCluster + local Redis.  The analog here is
+the real server on a loopback port with real sockets and threads.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.core import init_orca_context
+from analytics_zoo_tpu.serving import (ClusterServing, HTTPFrontend,
+                                       InferenceModel, InputQueue,
+                                       OutputQueue)
+from analytics_zoo_tpu.serving import protocol
+
+
+def _linear_model():
+    init_orca_context("local")
+
+    class M(nn.Module):
+        def forward(self, scope, x):
+            return scope.child(nn.Dense(3), x, name="fc")
+
+    m = M()
+    variables = m.init(__import__("jax").random.PRNGKey(0),
+                       np.zeros((1, 4), np.float32))
+    return m, variables
+
+
+@pytest.fixture(scope="module")
+def inference_model():
+    m, variables = _linear_model()
+    return InferenceModel(batch_buckets=(1, 4, 8)).load(m, variables)
+
+
+# -- InferenceModel alone -----------------------------------------------------
+
+def test_inference_model_bucket_padding(inference_model):
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    out = inference_model.predict(x)
+    assert out.shape == (3, 3)
+    # per-row result must not depend on bucket padding
+    row0 = inference_model.predict(x[:1])
+    np.testing.assert_allclose(out[0], row0[0], rtol=1e-5)
+
+
+def test_inference_model_chunking(inference_model):
+    x = np.random.default_rng(1).normal(size=(19, 4)).astype(np.float32)
+    out = inference_model.predict(x)          # 19 > largest bucket (8)
+    assert out.shape == (19, 3)
+    np.testing.assert_allclose(out[:4], inference_model.predict(x[:4]),
+                               rtol=1e-5)
+
+
+# -- ClusterServing round-trips ----------------------------------------------
+
+def test_serving_round_trip(inference_model):
+    with ClusterServing(inference_model, batch_size=4) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid = iq.enqueue("t", t=x)
+        out = oq.query(uid, timeout=20.0)
+        assert out is not None and out.shape == (3,)
+        expect = inference_model.predict(x[None])[0]
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_serving_concurrent_mixed_shapes(inference_model):
+    """Many clients, two different feature shapes, all answered correctly."""
+    with ClusterServing(inference_model, batch_size=8,
+                        batch_timeout_ms=20) as srv:
+        results = {}
+        errors = []
+
+        def client(i):
+            try:
+                iq = InputQueue(srv.host, srv.port)
+                oq = OutputQueue(input_queue=iq)
+                x = np.full((4,), float(i), np.float32)
+                uid = iq.enqueue(f"c{i}", t=x)
+                out = oq.query(uid, timeout=30.0)
+                results[i] = out
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 12
+        for i, out in results.items():
+            expect = inference_model.predict(
+                np.full((1, 4), float(i), np.float32))[0]
+            np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_serving_survives_header_only_frame(inference_model):
+    """ADVICE r1 (high): a header-only frame must get an error reply and must
+    NOT kill the batcher thread for everyone else."""
+    import socket
+    with ClusterServing(inference_model, batch_size=2) as srv:
+        raw = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            protocol.send_frame(raw, protocol.encode({"uuid": "bad-1"}))
+            reply = protocol.recv_frame(raw)
+            header, arr = protocol.decode(reply)
+            assert header["uuid"] == "bad-1" and "error" in header
+        finally:
+            raw.close()
+        # the server must still answer a valid request afterwards
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uid = iq.enqueue("ok", t=np.ones(4, np.float32))
+        assert oq.query(uid, timeout=20.0) is not None
+
+
+class _SlowModel:
+    """Stub standing in for InferenceModel: slow + optionally failing."""
+
+    def __init__(self, delay=0.0, fail=False):
+        self.delay = delay
+        self.fail = fail
+
+    def predict(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            raise ValueError("boom")
+        return np.asarray(x) * 2.0
+
+
+def test_serving_error_reply_reaches_client():
+    with ClusterServing(_SlowModel(fail=True), batch_size=2) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uid = iq.enqueue("t", t=np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            oq.query(uid, timeout=20.0)
+        # batcher survives a failing model too
+        uid2 = iq.enqueue("t2", t=np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            oq.query(uid2, timeout=20.0)
+
+
+def test_serving_backpressure_queue_full():
+    """With a 1-slot queue, a slow model, and a tiny push timeout, floods get
+    explicit 'queue full' error replies instead of silent drops."""
+    with ClusterServing(_SlowModel(delay=0.3), batch_size=1,
+                        queue_items=1, push_timeout=0.05) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uids = [iq.enqueue(f"f{i}", t=np.ones(2, np.float32))
+                for i in range(8)]
+        outcomes = {"ok": 0, "full": 0}
+        for uid in uids:
+            try:
+                out = oq.query(uid, timeout=30.0)
+                if out is not None:
+                    outcomes["ok"] += 1
+            except RuntimeError as e:
+                assert "queue full" in str(e)
+                outcomes["full"] += 1
+        assert outcomes["ok"] >= 1     # service still makes progress
+        assert outcomes["full"] >= 1   # and sheds load explicitly
+
+
+def test_native_queue_empty_payload():
+    """ADVICE r1 (low): a zero-length payload is a valid item, not a
+    timeout."""
+    from analytics_zoo_tpu.native import NativeQueue
+    q = NativeQueue(max_items=4)
+    assert q.push(b"", tag=7)
+    item = q.pop(timeout=1.0)
+    assert item is not None
+    payload, tag = item
+    assert payload == b"" and tag == 7
+
+
+# -- HTTP frontend ------------------------------------------------------------
+
+def test_http_frontend(inference_model):
+    with ClusterServing(inference_model, batch_size=4) as srv:
+        with HTTPFrontend(srv.host, srv.port) as fe:
+            url = f"http://{fe.host}:{fe.port}"
+            with urllib.request.urlopen(url + "/health", timeout=10) as r:
+                assert json.load(r)["status"] == "ok"
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"instances": [[1, 2, 3, 4]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                pred = json.load(r)["predictions"]
+            expect = inference_model.predict(
+                np.asarray([[1, 2, 3, 4]], np.float32))
+            np.testing.assert_allclose(np.asarray(pred), expect, rtol=1e-4)
+
+
+def test_http_frontend_bad_request(inference_model):
+    with ClusterServing(inference_model, batch_size=4) as srv:
+        with HTTPFrontend(srv.host, srv.port) as fe:
+            url = f"http://{fe.host}:{fe.port}/predict"
+            req = urllib.request.Request(
+                url, data=b'{"wrong": 1}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+
+
+def test_http_frontend_reconnects_after_backend_restart(inference_model):
+    """A backend restart must not permanently kill the HTTP frontend."""
+    srv = ClusterServing(inference_model, batch_size=4).start()
+    port = srv.port
+    fe = HTTPFrontend(srv.host, port).start()
+    try:
+        x = np.ones((1, 4), np.float32)
+        assert fe.predict(x) is not None
+        srv.stop()
+        deadline = time.time() + 10
+        while True:  # wait for the OS to release the port
+            try:
+                srv = ClusterServing(inference_model, port=port,
+                                     batch_size=4).start()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        out = fe.predict(x)  # reconnect happens inside predict
+        assert out is not None
+        np.testing.assert_allclose(np.squeeze(out),
+                                   np.squeeze(inference_model.predict(x)),
+                                   rtol=1e-5)
+    finally:
+        fe.stop()
+        srv.stop()
