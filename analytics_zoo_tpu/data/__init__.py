@@ -3,6 +3,9 @@
 from .feed import DataFeed, as_feed, batch_sharding, shard_batch
 from .readers import read_csv, read_json, read_npz, read_parquet
 from .shards import XShards
+from .stream import StreamingDataFeed
+from .image import (ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop,
+                    ImageRandomFlip, ImageNormalize)
 
 # reference-parity namespace: zoo.orca.data.pandas.read_csv
 from . import readers as pandas  # noqa: F401
@@ -10,4 +13,6 @@ from . import readers as pandas  # noqa: F401
 __all__ = [
     "XShards", "DataFeed", "as_feed", "batch_sharding", "shard_batch",
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
+    "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
+    "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize",
 ]

@@ -181,8 +181,10 @@ def as_feed(data: Any, batch_size: int, **kw: Any) -> DataFeed:
     Accepts: DataFeed (passthrough), XShards of numpy dicts, a (x, y) tuple,
     a dict {"x": ..., "y": ...}, or a bare array (unsupervised).
     """
-    if isinstance(data, DataFeed):
-        return data
+    if isinstance(data, DataFeed) or (
+            callable(getattr(data, "epoch", None))
+            and hasattr(data, "steps_per_epoch")):
+        return data  # DataFeed or a feed-alike (e.g. StreamingDataFeed)
     if isinstance(data, XShards):
         return DataFeed.from_shards(data, batch_size, **kw)
     if isinstance(data, dict):

@@ -1,0 +1,216 @@
+"""ImageSet: distributed image collection + preprocessing chain.
+
+Reference (SURVEY.md §2.2): Scala ``feature/image/*.scala`` +
+``pyzoo/zoo/feature/image/imageset.py`` — ``ImageSet.read`` produced a
+Local/DistributedImageSet of OpenCV Mats, transformed by a chain of
+``ImageProcessing`` stages (Resize, CenterCrop, Flip, ChannelNormalize,
+MatToTensor...) before feeding training.
+
+TPU-native redesign: decode/augment is HOST work that must overlap device
+compute (SURVEY §7 names input throughput a top hard part).  ImageSet holds
+*paths + labels* (cheap, shardable); decode + the transform chain run
+lazily in the streaming feed's worker threads (data/stream.py), which push
+ready batches through the native C++ queue while the chip trains.  NHWC
+uint8→float32 throughout (TPU conv layout; models/image.py is NHWC).
+
+Transforms are plain callables ``img[np.uint8 HWC] -> img``; the chain is
+a list, matching the reference's ImageProcessing pipeline composition.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .shards import XShards
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
+
+
+# -- transform chain (reference: ImageProcessing subclasses) -------------------
+
+class ImageResize:
+    """Bilinear resize to (h, w) (reference: image/Resize)."""
+
+    def __init__(self, h: int, w: int):
+        self.h, self.w = h, w
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        from PIL import Image
+        return np.asarray(Image.fromarray(img).resize(
+            (self.w, self.h), Image.BILINEAR))
+
+
+def _check_crop(img: np.ndarray, h: int, w: int, kind: str) -> None:
+    ih, iw = img.shape[:2]
+    if ih < h or iw < w:
+        raise ValueError(
+            f"{kind}({h}, {w}) got a {ih}x{iw} image — resize first "
+            f"(a silent undersized crop would break batch stacking later)")
+
+
+class ImageCenterCrop:
+    def __init__(self, h: int, w: int):
+        self.h, self.w = h, w
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        _check_crop(img, self.h, self.w, "ImageCenterCrop")
+        ih, iw = img.shape[:2]
+        top = (ih - self.h) // 2
+        left = (iw - self.w) // 2
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageRandomCrop:
+    def __init__(self, h: int, w: int):
+        self.h, self.w = h, w
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        _check_crop(img, self.h, self.w, "ImageRandomCrop")
+        rng = rng or np.random.default_rng()
+        ih, iw = img.shape[:2]
+        top = int(rng.integers(0, ih - self.h + 1))
+        left = int(rng.integers(0, iw - self.w + 1))
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageRandomFlip:
+    """Horizontal flip with probability p (reference: image/HFlip)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        return img[:, ::-1] if rng.random() < self.p else img
+
+
+class ImageNormalize:
+    """uint8 HWC → float32, (x/255 - mean) / std per channel (reference:
+    ChannelNormalize)."""
+
+    def __init__(self, mean: Sequence[float] = (0.485, 0.456, 0.406),
+                 std: Sequence[float] = (0.229, 0.224, 0.225)):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        return (img.astype(np.float32) / 255.0 - self.mean) / self.std
+
+
+def decode_image(path: str) -> np.ndarray:
+    """File → uint8 HWC RGB (reference: OpenCV imdecode behind JNI; here
+    PIL on the host — the chip never sees undecoded bytes)."""
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+def apply_chain(img: np.ndarray, transforms: Sequence[Callable],
+                rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    for t in transforms:
+        # random transforms take the feed's per-worker rng for determinism
+        img = (t(img, rng=rng)
+               if isinstance(t, (ImageRandomCrop, ImageRandomFlip))
+               else t(img))
+    return img
+
+
+# -- ImageSet ------------------------------------------------------------------
+
+class ImageSet:
+    """Paths + labels + transform chain; the decode work happens in the
+    streaming feed (reference: ImageSet.read → LocalImageSet /
+    DistributedImageSet)."""
+
+    def __init__(self, paths: Sequence[str],
+                 labels: Optional[Sequence[int]] = None,
+                 transforms: Optional[List[Callable]] = None,
+                 class_names: Optional[List[str]] = None):
+        self.paths = list(paths)
+        self.labels = None if labels is None else np.asarray(labels,
+                                                             np.int32)
+        self.transforms = list(transforms or [])
+        self.class_names = class_names
+
+    @staticmethod
+    def read(path: str, with_label: bool = True,
+             sharded: bool = False) -> "ImageSet":
+        """Read an image directory.  With labels: class-per-subdirectory
+        layout (the torchvision/ImageNet convention the reference's examples
+        used); without: a flat directory.
+
+        Multi-host: when ``sharded`` and jax.process_count() > 1, each host
+        keeps only its slice of the file list (SPMD file split, same contract
+        as data/readers.py)."""
+        paths: List[str] = []
+        labels: List[int] = []
+        class_names: Optional[List[str]] = None
+        if with_label:
+            class_names = sorted(
+                d for d in os.listdir(path)
+                if os.path.isdir(os.path.join(path, d)))
+            for ci, cname in enumerate(class_names):
+                for f in sorted(os.listdir(os.path.join(path, cname))):
+                    if f.lower().endswith(IMAGE_EXTS):
+                        paths.append(os.path.join(path, cname, f))
+                        labels.append(ci)
+        else:
+            for f in sorted(os.listdir(path)):
+                if f.lower().endswith(IMAGE_EXTS):
+                    paths.append(os.path.join(path, f))
+        if sharded:
+            import jax
+            n, i = jax.process_count(), jax.process_index()
+            paths = paths[i::n]
+            labels = labels[i::n] if with_label else labels
+        return ImageSet(paths, labels if with_label else None,
+                        class_names=class_names)
+
+    def transform(self, *transforms: Callable) -> "ImageSet":
+        """Append transform stages (chainable, reference-style)."""
+        self.transforms.extend(transforms)
+        return self
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    # -- materialization paths ------------------------------------------------
+
+    def load_sample(self, i: int,
+                    rng: Optional[np.random.Generator] = None
+                    ) -> Dict[str, np.ndarray]:
+        img = apply_chain(decode_image(self.paths[i]), self.transforms, rng)
+        out: Dict[str, np.ndarray] = {"x": np.ascontiguousarray(img)}
+        if self.labels is not None:
+            out["y"] = self.labels[i]
+        return out
+
+    def to_feed(self, batch_size: int, shuffle: bool = True, seed: int = 0,
+                num_workers: int = 4, prefetch_batches: int = 4,
+                drop_remainder: bool = True):
+        """A StreamingDataFeed that decodes/augments in worker threads and
+        prefetches batches through the native queue."""
+        from .stream import StreamingDataFeed
+        return StreamingDataFeed(
+            num_samples=len(self.paths), load_sample=self.load_sample,
+            batch_size=batch_size, shuffle=shuffle, seed=seed,
+            num_workers=num_workers, prefetch_batches=prefetch_batches,
+            drop_remainder=drop_remainder)
+
+    def to_shards(self, num_shards: int = 4) -> XShards:
+        """Eagerly decode everything into numpy-dict XShards (small sets;
+        the reference's LocalImageSet analog)."""
+        items = [self.load_sample(i) for i in range(len(self.paths))]
+        xs = np.stack([it["x"] for it in items])
+        data: Dict[str, Any] = {"x": xs}
+        if self.labels is not None:
+            data["y"] = self.labels.copy()
+        chunks = []
+        for part in np.array_split(np.arange(len(self.paths)), num_shards):
+            chunks.append({k: v[part] for k, v in data.items()})
+        return XShards(chunks)

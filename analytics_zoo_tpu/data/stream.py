@@ -1,0 +1,153 @@
+"""StreamingDataFeed: bounded-memory input pipeline over the native queue.
+
+Reference (SURVEY.md §2.2): FeatureSet cached the training set in DRAM/PMEM
+native arrays and fed per-worker mini-batches; the PMEM path existed
+precisely because datasets outgrow RAM.  DataFeed (feed.py) is the
+whole-dataset-in-RAM analog — fine for MNIST, disqualifying for ImageNet.
+
+This feed never materializes the dataset: worker threads pull sample
+indices, run the user loader (decode + augment for images), and stack
+batches.  The bounded C++ MPMC queue (native/zoo_native.cpp) is the
+synchronization/backpressure primitive between decoders and the consumer:
+workers push an 8-byte batch token (blocking when the bound is hit — that
+bound IS the memory bound), while the batch arrays themselves stay
+in-process in a token-keyed dict, so no payload bytes are copied.  The
+consumer pops tokens, claims batches, and double-buffers device placement
+so the host→HBM copy of batch N+1 overlaps compute of batch N.
+
+Same interface as DataFeed (global_batch / steps_per_epoch / remainder /
+epoch), so Estimator.fit takes either interchangeably.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.native import NativeQueue
+from .feed import shard_batch
+
+_ERROR_TOKEN = (1 << 63) - 1
+
+
+class StreamingDataFeed:
+    """Index-based streaming loader: ``load_sample(i, rng)`` → sample dict."""
+
+    def __init__(self, num_samples: int,
+                 load_sample: Callable[..., Dict[str, np.ndarray]],
+                 batch_size: int, shuffle: bool = True, seed: int = 0,
+                 num_workers: int = 4, prefetch_batches: int = 4,
+                 drop_remainder: bool = True):
+        self._n = num_samples
+        self._load = load_sample
+        self.global_batch = batch_size
+        self._local_batch = max(1, batch_size // max(1, jax.process_count()))
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.prefetch_batches = max(1, prefetch_batches)
+        self.drop_remainder = drop_remainder
+
+    # -- DataFeed interface ----------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self._n // self._local_batch
+        return -(-self._n // self._local_batch)
+
+    def remainder(self) -> Optional[Dict[str, np.ndarray]]:
+        r = self._n % self._local_batch
+        if r == 0:
+            return None
+        rng = np.random.default_rng(self.seed)
+        rows = [self._load(i, rng=rng) for i in range(self._n - r, self._n)]
+        return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
+
+    def epoch(self, mesh: Mesh, epoch_idx: int = 0
+              ) -> Iterator[Dict[str, jax.Array]]:
+        steps = self.steps_per_epoch()
+        if steps == 0:
+            raise ValueError(
+                f"dataset of {self._n} rows yields no batches of local "
+                f"size {self._local_batch}")
+        idx = np.arange(self._n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch_idx).shuffle(idx)
+
+        # the bounded native queue carries batch tokens; ready holds the
+        # actual arrays (at most prefetch_batches + num_workers entries,
+        # because push blocks when the queue is full)
+        queue = NativeQueue(max_items=self.prefetch_batches)
+        ready: Dict[int, Dict[str, np.ndarray]] = {}
+        ready_lock = threading.Lock()
+        step_iter = iter(range(steps))
+        step_lock = threading.Lock()
+        errors: List[BaseException] = []
+
+        def worker(wid: int) -> None:
+            rng = np.random.default_rng(
+                (self.seed + epoch_idx) * 10007 + wid)
+            while True:
+                with step_lock:
+                    step = next(step_iter, None)
+                if step is None:
+                    return
+                sel = idx[step * self._local_batch:
+                          (step + 1) * self._local_batch]
+                if len(sel) < self._local_batch:   # pad last partial batch
+                    sel = np.resize(sel, self._local_batch)
+                try:
+                    rows = [self._load(int(i), rng=rng) for i in sel]
+                    batch = {k: np.stack([r[k] for r in rows])
+                             for k in rows[0]}
+                except BaseException as e:          # noqa: BLE001 loader bug
+                    errors.append(e)
+                    try:
+                        queue.push(_ERROR_TOKEN.to_bytes(8, "big"))
+                    except RuntimeError:
+                        pass                        # consumer already gone
+                    return
+                with ready_lock:
+                    ready[step] = batch
+                try:
+                    queue.push(step.to_bytes(8, "big"))  # blocks when full
+                except RuntimeError:                # queue closed: abandon
+                    return
+
+        workers = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in workers:
+            t.start()
+
+        try:
+            pending = None
+            for _ in range(steps):
+                item = None
+                while item is None:                 # wait out slow decodes
+                    if errors:
+                        raise errors[0]
+                    item = queue.pop(timeout=1.0)
+                token = int.from_bytes(item[0], "big")
+                if token == _ERROR_TOKEN:
+                    raise (errors[0] if errors else
+                           RuntimeError("worker aborted"))
+                with ready_lock:
+                    host_batch = ready.pop(token)
+                batch = shard_batch(host_batch, mesh)
+                if pending is not None:
+                    yield pending                   # batch N computes while
+                pending = batch                     # N+1 already on device
+            if pending is not None:
+                yield pending
+        finally:
+            queue.close()
+            for t in workers:
+                t.join(timeout=5)

@@ -106,11 +106,24 @@ class NativeQueue:
             if rc == -2:
                 raise RuntimeError("queue closed")
             return rc == 0
-        try:
-            self._pyq.put((payload, tag), timeout=timeout)
-            return True
-        except pyqueue.Full:
-            return False
+        # poll in short slices so close() can wake a blocked producer (the
+        # C++ path gets this from the condvar broadcast in zn_queue_close)
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            if self._closed:
+                raise RuntimeError("queue closed")
+            slice_t = 0.05
+            if deadline is not None:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    return False
+                slice_t = min(slice_t, left)
+            try:
+                self._pyq.put((payload, tag), timeout=slice_t)
+                return True
+            except pyqueue.Full:
+                continue
 
     def pop(self, timeout: Optional[float] = None
             ) -> Optional[Tuple[bytes, int]]:

@@ -294,14 +294,29 @@ class ZooEstimator:
 
     def predict(self, data: Any, batch_size: int = 32,
                 feature_cols: Optional[Sequence[str]] = None) -> np.ndarray:
-        """Run forward over all rows (exact count, last batch padded+trimmed)."""
+        """Run forward over all rows (exact count, last batch padded+trimmed).
+
+        Raw arrays/shards are wrapped unshuffled with the tail padded; a
+        user-constructed feed must itself be unshuffled, and if it drops the
+        remainder the tail rows are predicted via ``feed.remainder()``.
+        """
         mesh = get_mesh()
         data = _maybe_select_cols(data, feature_cols, None)
         feed = as_feed(data, batch_size, shuffle=False, drop_remainder=False)
+        if getattr(feed, "shuffle", False):
+            raise ValueError(
+                "predict needs row order preserved: construct the feed with "
+                "shuffle=False")
         outs: List[np.ndarray] = []
         for batch in feed.epoch(mesh, 0):
             self._ensure_initialized(batch["x"])
             outs.append(np.asarray(self._pred_step(self._ts, batch["x"])))
+        if getattr(feed, "drop_remainder", False):
+            rem = feed.remainder()
+            if rem is not None:  # tail rows the epoch skipped (replicated)
+                x = jnp.asarray(rem["x"])
+                self._ensure_initialized(x)
+                outs.append(np.asarray(self._pred_step(self._ts, x)))
         return np.concatenate(outs, axis=0)[: feed.num_rows]
 
     # -- persistence ----------------------------------------------------------
