@@ -6,6 +6,7 @@ from .shards import XShards
 from .stream import StreamingDataFeed
 from .image import (ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop,
                     ImageRandomFlip, ImageNormalize)
+from .text import TextSet
 
 # reference-parity namespace: zoo.orca.data.pandas.read_csv
 from . import readers as pandas  # noqa: F401
@@ -14,5 +15,5 @@ __all__ = [
     "XShards", "DataFeed", "as_feed", "batch_sharding", "shard_batch",
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
     "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
-    "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize",
+    "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "TextSet",
 ]

@@ -82,13 +82,53 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(lambda l: place(l, True), batch)
 
 
-class DataFeed:
-    """An epoch-iterable source of device-resident, mesh-sharded batches.
+class FeedBase:
+    """Shared feed contract: global-vs-local batch math, epoch step count,
+    and the per-epoch shuffle index.  ``batch_size`` is the **global** batch
+    (reference Estimator semantics: pyzoo/zoo/orca/learn/pytorch/
+    pytorch_ray_estimator.py divided it across workers); each host
+    contributes batch_size / process_count rows."""
 
-    ``batch_size`` is the **global** batch (reference Estimator semantics:
-    pyzoo/zoo/orca/learn/pytorch/pytorch_ray_estimator.py divided it across
-    workers); each host contributes batch_size / process_count rows.
-    """
+    def __init__(self, num_samples: int, batch_size: int, shuffle: bool,
+                 seed: int, drop_remainder: bool):
+        self._n = num_samples
+        self.global_batch = batch_size
+        self._local_batch = max(1, batch_size // max(1, jax.process_count()))
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self._n // self._local_batch
+        return -(-self._n // self._local_batch)
+
+    def _epoch_index(self, epoch_idx: int) -> np.ndarray:
+        """Row order for one epoch; also validates it yields >= 1 batch."""
+        if self.steps_per_epoch() == 0:
+            raise ValueError(
+                f"dataset of {self._n} rows yields no batches of local "
+                f"size {self._local_batch}")
+        idx = np.arange(self._n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch_idx).shuffle(idx)
+        return idx
+
+    def _batch_index(self, idx: np.ndarray, step: int) -> np.ndarray:
+        sel = idx[step * self._local_batch:(step + 1) * self._local_batch]
+        if len(sel) < self._local_batch:  # pad the last partial batch
+            sel = np.resize(sel, self._local_batch)
+        return sel
+
+
+class DataFeed(FeedBase):
+    """An epoch-iterable source of device-resident, mesh-sharded batches,
+    holding the whole (host-local) dataset in RAM.  For datasets that don't
+    fit, use stream.StreamingDataFeed."""
 
     def __init__(self, data: Dict[str, Any], batch_size: int,
                  shuffle: bool = True, seed: int = 0,
@@ -96,17 +136,13 @@ class DataFeed:
         if "x" not in data:
             raise ValueError("DataFeed requires at least an 'x' entry")
         self._data = {k: v for k, v in data.items()}
-        self._n = _nrows(self._data["x"])
+        n = _nrows(self._data["x"])
         for k, v in self._data.items():
-            if _nrows(v) != self._n:
+            if _nrows(v) != n:
                 raise ValueError(
                     f"feature/label row mismatch: {k} has {_nrows(v)} rows, "
-                    f"x has {self._n}")
-        self.global_batch = batch_size
-        self._local_batch = max(1, batch_size // max(1, jax.process_count()))
-        self.shuffle = shuffle
-        self.seed = seed
-        self.drop_remainder = drop_remainder
+                    f"x has {n}")
+        super().__init__(n, batch_size, shuffle, seed, drop_remainder)
 
     # -- constructors ---------------------------------------------------------
 
@@ -129,15 +165,6 @@ class DataFeed:
 
     # -- iteration ------------------------------------------------------------
 
-    @property
-    def num_rows(self) -> int:
-        return self._n
-
-    def steps_per_epoch(self) -> int:
-        if self.drop_remainder:
-            return self._n // self._local_batch
-        return -(-self._n // self._local_batch)
-
     def remainder(self) -> Optional[Dict[str, np.ndarray]]:
         """The tail rows a drop_remainder epoch skips (unshuffled order), or
         None.  Used by Estimator.evaluate so metrics cover every row."""
@@ -150,20 +177,11 @@ class DataFeed:
     def epoch(self, mesh: Mesh, epoch_idx: int = 0
               ) -> Iterator[Dict[str, jax.Array]]:
         """Yield mesh-sharded batches for one epoch (one-batch lookahead)."""
+        idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
-        if steps == 0:
-            raise ValueError(
-                f"dataset of {self._n} rows yields no batches of local size "
-                f"{self._local_batch}")
-        idx = np.arange(self._n)
-        if self.shuffle:
-            np.random.default_rng(self.seed + epoch_idx).shuffle(idx)
 
         def host_batch(step: int) -> Dict[str, np.ndarray]:
-            sel = idx[step * self._local_batch:(step + 1) * self._local_batch]
-            if len(sel) < self._local_batch:  # pad the last partial batch
-                pad = np.resize(sel, self._local_batch)
-                sel = pad
+            sel = self._batch_index(idx, step)
             return jax.tree_util.tree_map(
                 lambda a: _take(a, sel), self._data)
 
@@ -181,10 +199,8 @@ def as_feed(data: Any, batch_size: int, **kw: Any) -> DataFeed:
     Accepts: DataFeed (passthrough), XShards of numpy dicts, a (x, y) tuple,
     a dict {"x": ..., "y": ...}, or a bare array (unsupervised).
     """
-    if isinstance(data, DataFeed) or (
-            callable(getattr(data, "epoch", None))
-            and hasattr(data, "steps_per_epoch")):
-        return data  # DataFeed or a feed-alike (e.g. StreamingDataFeed)
+    if isinstance(data, FeedBase):
+        return data  # DataFeed / StreamingDataFeed / any FeedBase subclass
     if isinstance(data, XShards):
         return DataFeed.from_shards(data, batch_size, **kw)
     if isinstance(data, dict):

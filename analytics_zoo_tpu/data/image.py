@@ -110,13 +110,29 @@ def decode_image(path: str) -> np.ndarray:
         return np.asarray(im.convert("RGB"))
 
 
+def _takes_rng(t: Callable) -> bool:
+    """Does the transform accept the feed's rng (for deterministic
+    augmentation)?  Detected by signature so user transforms participate,
+    cached on the object."""
+    cached = getattr(t, "_zoo_takes_rng", None)
+    if cached is None:
+        import inspect
+        try:
+            cached = "rng" in inspect.signature(t).parameters
+        except (TypeError, ValueError):
+            cached = False
+        try:
+            t._zoo_takes_rng = cached
+        except AttributeError:
+            pass  # unsettable (e.g. builtin); re-inspect next time
+    return cached
+
+
 def apply_chain(img: np.ndarray, transforms: Sequence[Callable],
                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
     for t in transforms:
         # random transforms take the feed's per-worker rng for determinism
-        img = (t(img, rng=rng)
-               if isinstance(t, (ImageRandomCrop, ImageRandomFlip))
-               else t(img))
+        img = t(img, rng=rng) if _takes_rng(t) else t(img)
     return img
 
 

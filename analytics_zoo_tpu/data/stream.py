@@ -12,11 +12,13 @@ synchronization/backpressure primitive between decoders and the consumer:
 workers push an 8-byte batch token (blocking when the bound is hit — that
 bound IS the memory bound), while the batch arrays themselves stay
 in-process in a token-keyed dict, so no payload bytes are copied.  The
-consumer pops tokens, claims batches, and double-buffers device placement
-so the host→HBM copy of batch N+1 overlaps compute of batch N.
+consumer reorders tokens so batches always arrive in STEP ORDER regardless
+of worker timing (predict depends on row order; training gets reproducible
+batch sequences), and double-buffers device placement so the host→HBM copy
+of batch N+1 overlaps compute of batch N.
 
-Same interface as DataFeed (global_batch / steps_per_epoch / remainder /
-epoch), so Estimator.fit takes either interchangeably.
+Same interface as DataFeed (both subclass feed.FeedBase), so Estimator.fit
+takes either interchangeably.
 """
 
 from __future__ import annotations
@@ -24,17 +26,16 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Iterator, List, Optional
 
-import jax
 import numpy as np
 from jax.sharding import Mesh
 
 from analytics_zoo_tpu.native import NativeQueue
-from .feed import shard_batch
+from .feed import FeedBase, shard_batch
 
 _ERROR_TOKEN = (1 << 63) - 1
 
 
-class StreamingDataFeed:
+class StreamingDataFeed(FeedBase):
     """Index-based streaming loader: ``load_sample(i, rng)`` → sample dict."""
 
     def __init__(self, num_samples: int,
@@ -42,26 +43,11 @@ class StreamingDataFeed:
                  batch_size: int, shuffle: bool = True, seed: int = 0,
                  num_workers: int = 4, prefetch_batches: int = 4,
                  drop_remainder: bool = True):
-        self._n = num_samples
+        super().__init__(num_samples, batch_size, shuffle, seed,
+                         drop_remainder)
         self._load = load_sample
-        self.global_batch = batch_size
-        self._local_batch = max(1, batch_size // max(1, jax.process_count()))
-        self.shuffle = shuffle
-        self.seed = seed
         self.num_workers = max(1, num_workers)
         self.prefetch_batches = max(1, prefetch_batches)
-        self.drop_remainder = drop_remainder
-
-    # -- DataFeed interface ----------------------------------------------------
-
-    @property
-    def num_rows(self) -> int:
-        return self._n
-
-    def steps_per_epoch(self) -> int:
-        if self.drop_remainder:
-            return self._n // self._local_batch
-        return -(-self._n // self._local_batch)
 
     def remainder(self) -> Optional[Dict[str, np.ndarray]]:
         r = self._n % self._local_batch
@@ -72,15 +58,9 @@ class StreamingDataFeed:
         return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
 
     def epoch(self, mesh: Mesh, epoch_idx: int = 0
-              ) -> Iterator[Dict[str, jax.Array]]:
+              ) -> Iterator[Dict[str, "np.ndarray"]]:
+        idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
-        if steps == 0:
-            raise ValueError(
-                f"dataset of {self._n} rows yields no batches of local "
-                f"size {self._local_batch}")
-        idx = np.arange(self._n)
-        if self.shuffle:
-            np.random.default_rng(self.seed + epoch_idx).shuffle(idx)
 
         # the bounded native queue carries batch tokens; ready holds the
         # actual arrays (at most prefetch_batches + num_workers entries,
@@ -100,10 +80,7 @@ class StreamingDataFeed:
                     step = next(step_iter, None)
                 if step is None:
                     return
-                sel = idx[step * self._local_batch:
-                          (step + 1) * self._local_batch]
-                if len(sel) < self._local_batch:   # pad last partial batch
-                    sel = np.resize(sel, self._local_batch)
+                sel = self._batch_index(idx, step)
                 try:
                     rows = [self._load(int(i), rng=rng) for i in sel]
                     batch = {k: np.stack([r[k] for r in rows])
@@ -127,21 +104,39 @@ class StreamingDataFeed:
         for t in workers:
             t.start()
 
-        try:
-            pending = None
-            for _ in range(steps):
-                item = None
-                while item is None:                 # wait out slow decodes
-                    if errors:
-                        raise errors[0]
-                    item = queue.pop(timeout=1.0)
-                token = int.from_bytes(item[0], "big")
-                if token == _ERROR_TOKEN:
+        bound = self.prefetch_batches + self.num_workers
+
+        def take(expected_step: int) -> Dict[str, np.ndarray]:
+            """Next batch in step order; holds out-of-order arrivals.  Live
+            because steps are claimed in order: the token for
+            ``expected_step`` is pushed or being produced.  Bounded because
+            once ``ready`` holds ``bound`` batches the consumer stops
+            draining tokens — workers then block on the full queue, halting
+            production while the straggler decode finishes (workers insert
+            into ``ready`` BEFORE their token push, so the straggler's
+            batch still lands)."""
+            import time as _time
+            while True:
+                with ready_lock:
+                    if expected_step in ready:
+                        return ready.pop(expected_step)
+                    oversized = len(ready) >= bound
+                if errors:
+                    raise errors[0]
+                if oversized:
+                    _time.sleep(0.005)
+                    continue
+                item = queue.pop(timeout=0.2)
+                if item is None:
+                    continue                        # wait out slow decodes
+                if int.from_bytes(item[0], "big") == _ERROR_TOKEN:
                     raise (errors[0] if errors else
                            RuntimeError("worker aborted"))
-                with ready_lock:
-                    host_batch = ready.pop(token)
-                batch = shard_batch(host_batch, mesh)
+
+        try:
+            pending = None
+            for step in range(steps):
+                batch = shard_batch(take(step), mesh)
                 if pending is not None:
                     yield pending                   # batch N computes while
                 pending = batch                     # N+1 already on device

@@ -1,0 +1,6 @@
+"""Friesian: recommender-system feature engineering (reference SURVEY.md
+§2.2 — pyzoo/zoo/friesian/feature/table.py on Spark DataFrames)."""
+
+from .table import FeatureTable, StringIndex
+
+__all__ = ["FeatureTable", "StringIndex"]

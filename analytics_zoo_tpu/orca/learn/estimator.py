@@ -314,7 +314,7 @@ class ZooEstimator:
         if getattr(feed, "drop_remainder", False):
             rem = feed.remainder()
             if rem is not None:  # tail rows the epoch skipped (replicated)
-                x = jnp.asarray(rem["x"])
+                x = jax.tree_util.tree_map(jnp.asarray, rem["x"])
                 self._ensure_initialized(x)
                 outs.append(np.asarray(self._pred_step(self._ts, x)))
         return np.concatenate(outs, axis=0)[: feed.num_rows]

@@ -157,6 +157,11 @@ def test_predict_on_streaming_feed_covers_all_rows(tmp_path):
     est.fit(feed, epochs=1, batch_size=8, verbose=False)
     preds = est.predict(feed, batch_size=8)
     assert preds.shape == (20, 2)   # 2 full batches + 4-row remainder
+    # row ALIGNMENT must hold under multi-worker decode (regression: batches
+    # used to arrive in completion order, silently permuting predictions)
+    direct = est.predict(
+        np.stack([loader(i)["x"] for i in range(20)]), batch_size=8)
+    np.testing.assert_allclose(preds, direct, rtol=1e-5)
     shuffled = StreamingDataFeed(num_samples=20, load_sample=loader,
                                  batch_size=8, shuffle=True)
     with pytest.raises(ValueError, match="shuffle=False"):

@@ -1,0 +1,97 @@
+"""TextSet pipeline (VERDICT r1 missing #5): tokenize → word2idx → pad →
+feed, wired into TextClassifier training.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+from analytics_zoo_tpu.data import TextSet
+
+TEXTS = [
+    "The cat sat on the mat",
+    "Dogs chase the cat around",
+    "I love training models on TPUs",
+    "XLA compiles the whole step",
+    "the mat was sat on by a cat",
+    "models love big batches",
+    "a dog and a cat met",
+    "compilers fuse elementwise ops",
+]
+LABELS = [0, 0, 1, 1, 0, 1, 0, 1]
+
+
+def test_tokenize_normalize_word2idx():
+    ts = TextSet.from_texts(TEXTS, LABELS).tokenize().normalize().word2idx()
+    assert ts.word_index is not None
+    # most frequent word is "the" → id 2 (0=pad, 1=oov)
+    assert ts.word_index["the"] == 2
+    assert "cat" in ts.word_index
+    # ids are consistent with the index
+    ts.shape_sequence(8)
+    x, y = ts.to_numpy()
+    assert x.shape == (8, 8) and x.dtype == np.int32
+    assert y.shape == (8,)
+    row = x[0]
+    toks = [w.lower() for w in TEXTS[0].split()]
+    for tok, idx in zip(toks, row):
+        assert ts.word_index[tok] == idx
+
+
+def test_shape_sequence_pad_and_truncate():
+    ts = TextSet.from_texts(["a b c d e f", "a b"]).word2idx()
+    ts.shape_sequence(4, trunc_mode="pre")
+    x, _ = ts.to_numpy()
+    assert x.shape == (2, 4)
+    assert np.all(x[1][2:] == 0)           # padded with PAD_ID
+    ts2 = TextSet.from_texts(["a b c d e f"]).word2idx()
+    pre = ts2.shape_sequence(3, trunc_mode="pre").to_numpy()[0][0]
+    ts3 = TextSet.from_texts(["a b c d e f"]).word2idx()
+    post = ts3.shape_sequence(3, trunc_mode="post").to_numpy()[0][0]
+    assert not np.array_equal(pre, post)   # tail kept vs head kept
+
+
+def test_word2idx_existing_index_and_oov():
+    train = TextSet.from_texts(TEXTS[:4]).word2idx()
+    val = TextSet.from_texts(["the zebra sat"]).word2idx(
+        existing_index=train.word_index)
+    val.shape_sequence(4)
+    x, _ = val.to_numpy()
+    assert x[0][0] == train.word_index["the"]
+    assert x[0][1] == 1                    # "zebra" unseen → OOV id
+    assert val.vocab_size() == train.vocab_size()
+
+
+def test_word_index_round_trip(tmp_path):
+    ts = TextSet.from_texts(TEXTS).word2idx(max_words_num=10)
+    p = str(tmp_path / "wi.json")
+    ts.save_word_index(p)
+    wi = TextSet.load_word_index(p)
+    assert wi == ts.word_index
+
+
+def test_textset_min_freq():
+    ts = TextSet.from_texts(TEXTS).word2idx(min_freq=2)
+    assert "the" in ts.word_index
+    assert "compiles" not in ts.word_index  # appears once
+
+
+def test_textset_feeds_textclassifier():
+    """The reference flow: TextSet pipeline → TextClassifier.fit."""
+    from analytics_zoo_tpu.models import TextClassifier
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    ts = (TextSet.from_texts(TEXTS, LABELS).tokenize().normalize()
+          .word2idx().shape_sequence(8))
+    model = TextClassifier(class_num=2, vocab_size=ts.vocab_size(),
+                           token_length=16, sequence_length=8,
+                           encoder="cnn", encoder_output_dim=16)
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2)
+    hist = est.fit(ts.to_feed(batch_size=8), epochs=2, batch_size=8,
+                   verbose=False)
+    assert np.isfinite(hist["loss"][-1])
+    x, _ = ts.to_numpy()
+    preds = est.predict(x, batch_size=8)
+    assert preds.shape == (8, 2)
