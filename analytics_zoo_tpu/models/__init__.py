@@ -17,8 +17,10 @@ from .seq2seq import Seq2seq, RNNEncoder, RNNDecoder
 from .image import ImageClassifier, ResNet
 from .objectdetection import ObjectDetector, SSDLite
 from .bert import BERT, BERTClassifier, BERTSQuAD
+from .net import ForeignNet, Net
 
 __all__ = [
+    "Net", "ForeignNet",
     "ZooModel", "NeuralCF", "WideAndDeep", "SessionRecommender",
     "UserItemFeature", "UserItemPrediction", "TextClassifier", "KNRM",
     "AnomalyDetector", "unroll", "Seq2seq", "RNNEncoder", "RNNDecoder",

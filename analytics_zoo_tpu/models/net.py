@@ -1,0 +1,481 @@
+"""Foreign-model import: TF/Keras and PyTorch models → the nn module system.
+
+Reference (SURVEY.md §2.3): the reference ran foreign models through JNI
+engine bridges — TFNet executed frozen TF graphs via libtensorflow
+(zoo/.../pipeline/api/net/TFNet.scala), TorchNet ran TorchScript via
+libtorch (Torch*.scala), loaded from Python by ``Net.load_tf`` /
+``Net.load_torch`` (pyzoo/zoo/pipeline/api/net.py).
+
+TPU-native redesign: there is no second engine to bridge to — a foreign
+model is *converted* into this framework's pure-function modules + a baked
+variables pytree, then jit-compiles onto the TPU like any native model
+(and can be fine-tuned by the Estimator, which the JNI bridges could not).
+Conversion covers the common layer vocabulary (dense/conv/pool/norm/
+embedding/activation chains — the zoo.models-scale subset); anything else
+raises with pointers to the escape hatch:
+
+  ESCAPE HATCH: write the forward as an ``nn.Module`` yourself and pour the
+  foreign weights in via ``Net.torch_params_to_tree(mod)`` (name→array dict
+  of every torch parameter/buffer) or ``model.get_weights()`` on the Keras
+  side, then construct variables for your module directly.
+
+Differential tests (tests/test_net.py) assert converted outputs match the
+source framework within float tolerance — the SURVEY §4.4 pattern.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.nn.module import Module, Params, Scope
+
+
+class ForeignNet(Module):
+    """A converted foreign model: a linear chain of native layers whose
+    weights came from the source framework (baked into ``init``)."""
+
+    def __init__(self, stages: Sequence[Tuple[str, Module]],
+                 variables: Params, source: str, nchw_input: bool = False):
+        super().__init__(name=None)
+        self.stages = list(stages)
+        self._variables = variables
+        self.source = source
+        #: torch convnets take NCHW; the converted net transposes to NHWC at
+        #: the boundary so callers keep feeding torch-layout arrays
+        self.nchw_input = nchw_input
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        if self.nchw_input and x.ndim == 4:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        for name, mod in self.stages:
+            x = scope.child(mod, x, name=name)
+        if self.nchw_input and x.ndim == 4:
+            # symmetric boundary: a conv-ending net hands back torch layout
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        return x
+
+    def init(self, rng: jax.Array, *args: Any, **kwargs: Any) -> Params:
+        """The imported weights, not a random init."""
+        return jax.tree_util.tree_map(jnp.asarray,
+                                      copy.deepcopy(self._variables))
+
+
+class Net:
+    """Loader namespace (reference: ``Net.load_tf/load_torch/load_bigdl``)."""
+
+    # -- torch -----------------------------------------------------------------
+
+    @staticmethod
+    def load_torch(module: Any, example_input: Any) -> ForeignNet:
+        """Convert a ``torch.nn.Module`` (or TorchScript file path) whose
+        execution is a Sequential chain of supported leaf layers.
+
+        ``example_input``: one real input batch (torch NCHW layout for conv
+        nets) — used to trace per-layer input shapes, which the conversion
+        needs (e.g. reordering Linear weights that follow a Flatten of NCHW
+        feature maps into NHWC order)."""
+        import torch
+        if isinstance(module, str):
+            try:
+                module = torch.jit.load(module)
+            except RuntimeError:
+                module = torch.load(module, weights_only=False)
+        module = module.eval()
+        leaves = _torch_leaves(module)
+        x = torch.as_tensor(np.asarray(example_input))
+        shapes = _torch_trace_shapes(module, leaves, x)
+        nchw = x.ndim == 4
+        stages: List[Tuple[str, Module]] = []
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        # NCHW shape the last Flatten consumed; carried through order-
+        # preserving layers (Dropout/activations) until the first Linear
+        # uses it to reorder its kernel rows into NHWC flatten order
+        flat_origin: Optional[Tuple[int, ...]] = None
+        for i, leaf in enumerate(leaves):
+            kind = _torch_kind(leaf)
+            name = f"{i}_{kind.lower()}"
+            conv = _TORCH_CONVERTERS.get(kind)
+            if conv is None:
+                raise NotImplementedError(
+                    f"torch layer {kind} is not in the supported conversion "
+                    f"set {sorted(_TORCH_CONVERTERS)}; see the escape hatch "
+                    "in analytics_zoo_tpu.models.net's docstring")
+            mod, p, s = conv(leaf, shapes[i], flat_origin)
+            if kind == "Flatten" and len(shapes[i]) == 4:
+                flat_origin = tuple(shapes[i])
+            elif kind == "Linear":
+                flat_origin = None  # consumed: later Linears see mixed space
+            if mod is None:
+                continue  # identity (e.g. Dropout at inference keeps staged)
+            stages.append((name, mod))
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return ForeignNet(stages, {"params": params, "state": state},
+                          source="torch", nchw_input=nchw)
+
+    @staticmethod
+    def torch_params_to_tree(module: Any) -> Dict[str, np.ndarray]:
+        """Escape hatch: every parameter and buffer as {dotted_name: array}."""
+        out = {}
+        for n, p in module.state_dict().items():
+            out[n] = p.detach().cpu().numpy()
+        return out
+
+    # -- tf/keras --------------------------------------------------------------
+
+    @staticmethod
+    def load_tf(model_or_path: Any) -> ForeignNet:
+        """Convert a ``tf.keras`` model (object, .h5/.keras file, or a
+        SavedModel/keras dir) built as a Sequential chain of supported
+        layers.  Non-Keras SavedModels (raw ConcreteFunctions) are not
+        convertible — re-export through tf.keras or use the escape hatch."""
+        import tensorflow as tf
+        model = model_or_path
+        if isinstance(model, str):
+            model = tf.keras.models.load_model(model)
+        layers = [l for l in model.layers
+                  if type(l).__name__ != "InputLayer"]
+        if not isinstance(model, tf.keras.Sequential):
+            # a functional graph can branch/merge in ways model.layers
+            # order does not represent — inbound-node counting cannot
+            # detect fan-out reliably, so only Sequential converts
+            raise NotImplementedError(
+                "only tf.keras.Sequential models convert automatically "
+                "(functional graphs may branch); see the escape hatch in "
+                "analytics_zoo_tpu.models.net's docstring")
+        stages: List[Tuple[str, Module]] = []
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        for i, layer in enumerate(layers):
+            kind = type(layer).__name__
+            name = f"{i}_{kind.lower()}"
+            conv = _TF_CONVERTERS.get(kind)
+            if conv is None:
+                raise NotImplementedError(
+                    f"keras layer {kind} is not in the supported conversion "
+                    f"set {sorted(_TF_CONVERTERS)}; see the escape hatch in "
+                    "analytics_zoo_tpu.models.net's docstring")
+            mod, p, s = conv(layer)
+            if mod is None:
+                continue
+            stages.append((name, mod))
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return ForeignNet(stages, {"params": params, "state": state},
+                          source="tf")
+
+    # -- consciously dropped formats ------------------------------------------
+
+    @staticmethod
+    def load_bigdl(*a: Any, **k: Any) -> None:
+        raise NotImplementedError(
+            "BigDL protobuf serialization is a JVM-era format with no "
+            "TPU-side runtime; retrain or re-export via torch/keras "
+            "(consciously dropped, SURVEY.md §2.3)")
+
+    load_caffe = load_bigdl
+
+
+# -- torch helpers -------------------------------------------------------------
+
+def _torch_kind(m: Any) -> str:
+    n = type(m).__name__
+    if n == "RecursiveScriptModule":  # TorchScript wrapper
+        return m.original_name
+    return n
+
+
+def _torch_leaves(m: Any) -> List[Any]:
+    kids = list(m.children())
+    if not kids:
+        return [m]
+    kind = _torch_kind(m)
+    if kind not in ("Sequential", "ModuleList"):
+        raise NotImplementedError(
+            f"torch container {kind} does not guarantee Sequential "
+            "execution; only nn.Sequential trees convert automatically "
+            "(see the escape hatch in analytics_zoo_tpu.models.net)")
+    out: List[Any] = []
+    for k in kids:
+        out.extend(_torch_leaves(k))
+    return out
+
+
+def _torch_trace_shapes(module: Any, leaves: List[Any], x: Any
+                        ) -> List[Tuple[int, ...]]:
+    """Input shape of every leaf, by running the chain leaf-by-leaf (valid
+    because only Sequential trees are accepted; forward hooks would be the
+    general tool but ScriptModules don't support them)."""
+    import torch
+    shapes: List[Tuple[int, ...]] = []
+    with torch.no_grad():
+        for leaf in leaves:
+            shapes.append(tuple(x.shape))
+            x = leaf(x)
+    return shapes
+
+
+def _np(t: Any) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _t_linear(m, in_shape, prev_flat):
+    w = _np(m.weight)                    # [out, in]
+    kernel = w.T.copy()                  # [in, out]
+    if prev_flat is not None:
+        # the Linear consumed a Flatten of NCHW maps, but the converted net
+        # flattens NHWC: reorder kernel rows c*H*W+h*W+w → h*W*C+w*C+c
+        _, c, h, wid = prev_flat
+        perm = np.arange(c * h * wid).reshape(c, h, wid)
+        perm = perm.transpose(1, 2, 0).reshape(-1)  # NHWC order → NCHW index
+        kernel = kernel[perm]
+    p = {"kernel": kernel}
+    if m.bias is not None:
+        p["bias"] = _np(m.bias)
+    return nn.Dense(m.out_features, use_bias=m.bias is not None), p, {}
+
+
+def _t_conv2d(m, in_shape, prev_flat):
+    stride = tuple(m.stride)
+    pad = m.padding
+    k = tuple(m.kernel_size)
+    if isinstance(pad, str):        # torch accepts 'same'/'valid' directly
+        pad = ((0, 0) if pad == "valid"
+               else (k[0] // 2, k[1] // 2) if stride == (1, 1)
+               else pad)            # 'same' at stride>1: fall through/raise
+    elif isinstance(pad, int):
+        pad = (pad, pad)
+    else:
+        pad = tuple(pad)
+    if pad == (0, 0):
+        padding = "valid"
+    elif (stride == (1, 1) and k[0] % 2 == 1 and k[1] % 2 == 1
+          and pad == (k[0] // 2, k[1] // 2)):
+        padding = "same"   # exact equivalence only at stride 1 / odd kernel
+    else:
+        raise NotImplementedError(
+            f"torch Conv2d padding={pad} stride={stride} has no exact "
+            "same/valid equivalent; use the escape hatch")
+    p = {"kernel": _np(m.weight).transpose(2, 3, 1, 0)}  # OIHW → HWIO
+    if m.bias is not None:
+        p["bias"] = _np(m.bias)
+    return (nn.Conv2D(m.out_channels, k, stride, padding,
+                      use_bias=m.bias is not None, groups=m.groups,
+                      dilation=tuple(m.dilation)), p, {})
+
+
+def _t_batchnorm(m, in_shape, prev_flat):
+    if m.running_mean is None:
+        raise NotImplementedError(
+            "BatchNorm with track_running_stats=False evaluates on batch "
+            "statistics, which this converter's inference semantics don't "
+            "replicate; use the escape hatch")
+    if m.momentum is None:
+        raise NotImplementedError(
+            "BatchNorm with momentum=None (cumulative averaging) has no "
+            "equivalent here; use the escape hatch")
+    affine = m.weight is not None
+    # torch: running = (1-mom)*running + mom*batch; ours: m*run + (1-m)*batch
+    mod = nn.BatchNormalization(momentum=1.0 - m.momentum, epsilon=m.eps,
+                                center=affine, scale=affine)
+    p = ({"gamma": _np(m.weight), "beta": _np(m.bias)} if affine else {})
+    s = {"mean": _np(m.running_mean), "var": _np(m.running_var)}
+    return mod, p, s
+
+
+def _t_layernorm(m, in_shape, prev_flat):
+    if len(m.normalized_shape) != 1:
+        raise NotImplementedError(
+            f"LayerNorm over {len(m.normalized_shape)} trailing dims has no "
+            "equivalent (last-axis only); use the escape hatch")
+    if m.weight is None:
+        raise NotImplementedError(
+            "LayerNorm(elementwise_affine=False) is unsupported; use the "
+            "escape hatch")
+    return (nn.LayerNormalization(epsilon=m.eps),
+            {"gamma": _np(m.weight), "beta": _np(m.bias)}, {})
+
+
+def _t_embedding(m, in_shape, prev_flat):
+    return (nn.Embedding(m.num_embeddings, m.embedding_dim),
+            {"embeddings": _np(m.weight)}, {})
+
+
+def _t_act(name):
+    def conv(m, in_shape, prev_flat):
+        return nn.Activation(name), {}, {}
+    return conv
+
+
+def _t_pool(kind):
+    def conv(m, in_shape, prev_flat):
+        k = m.kernel_size
+        k = (k, k) if isinstance(k, int) else tuple(k)
+        s = m.stride or k
+        s = (s, s) if isinstance(s, int) else tuple(s)
+        pad = m.padding
+        pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+        if pad != (0, 0):
+            raise NotImplementedError(
+                "torch pooling with padding has no exact equivalent here; "
+                "use the escape hatch")
+        cls = nn.MaxPooling2D if kind == "max" else nn.AveragePooling2D
+        return cls(k, s, padding="valid"), {}, {}
+    return conv
+
+
+def _t_flatten(m, in_shape, prev_flat):
+    return nn.Flatten(), {}, {}
+
+
+def _t_dropout(m, in_shape, prev_flat):
+    return nn.Dropout(m.p), {}, {}
+
+
+def _t_adaptive_avg(m, in_shape, prev_flat):
+    out = m.output_size
+    out = (out, out) if isinstance(out, int) else tuple(out)
+    if out not in ((1, 1), (1,)):
+        raise NotImplementedError(
+            "AdaptiveAvgPool2d converts only for output_size=1 "
+            "(global average)")
+
+    class _Glob(Module):
+        def forward(self, scope, x):
+            return x.mean(axis=(1, 2), keepdims=True)
+
+    return _Glob(), {}, {}
+
+
+_TORCH_CONVERTERS: Dict[str, Callable] = {
+    "Linear": _t_linear,
+    "Conv2d": _t_conv2d,
+    "BatchNorm1d": _t_batchnorm,
+    "BatchNorm2d": _t_batchnorm,
+    "LayerNorm": _t_layernorm,
+    "Embedding": _t_embedding,
+    "ReLU": _t_act("relu"),
+    # torch GELU defaults to the exact erf form; jax.nn.gelu defaults to
+    # the tanh approximation — pick by the module's own setting
+    "GELU": lambda m, s, f: (nn.Activation(
+        (lambda x: jax.nn.gelu(x, approximate=False))
+        if getattr(m, "approximate", "none") == "none"
+        else (lambda x: jax.nn.gelu(x, approximate=True))), {}, {}),
+    "Tanh": _t_act("tanh"),
+    "Sigmoid": _t_act("sigmoid"),
+    "Softmax": _t_act("softmax"),
+    "Flatten": _t_flatten,
+    "Dropout": _t_dropout,
+    "MaxPool2d": _t_pool("max"),
+    "AvgPool2d": _t_pool("avg"),
+    "AdaptiveAvgPool2d": _t_adaptive_avg,
+    "Identity": lambda m, s, f: (None, {}, {}),
+}
+
+
+# -- keras helpers -------------------------------------------------------------
+
+def _k_weights(layer) -> List[np.ndarray]:
+    return [np.asarray(w) for w in layer.get_weights()]
+
+
+def _k_dense(layer):
+    w = _k_weights(layer)
+    cfg = layer.get_config()
+    p = {"kernel": w[0]}
+    if cfg.get("use_bias", True):
+        p["bias"] = w[1]
+    return (nn.Dense(cfg["units"], activation=cfg.get("activation"),
+                     use_bias=cfg.get("use_bias", True)), p, {})
+
+
+def _k_conv2d(layer):
+    w = _k_weights(layer)
+    cfg = layer.get_config()
+    p = {"kernel": w[0]}  # keras stores HWIO already
+    if cfg.get("use_bias", True):
+        p["bias"] = w[1]
+    return (nn.Conv2D(cfg["filters"], tuple(cfg["kernel_size"]),
+                      tuple(cfg["strides"]), cfg["padding"],
+                      activation=cfg.get("activation"),
+                      use_bias=cfg.get("use_bias", True),
+                      dilation=tuple(cfg.get("dilation_rate", (1, 1))),
+                      groups=cfg.get("groups", 1)), p, {})
+
+
+def _k_batchnorm(layer):
+    cfg = layer.get_config()
+    if cfg.get("axis") not in (-1, [len(layer.input.shape) - 1],
+                               len(layer.input.shape) - 1, [-1], 3, [3]):
+        raise NotImplementedError("BatchNormalization converts on the "
+                                  "channel-last axis only")
+    w = _k_weights(layer)
+    i = 0
+    p = {}
+    if cfg.get("scale", True):
+        p["gamma"] = w[i]; i += 1  # noqa: E702
+    if cfg.get("center", True):
+        p["beta"] = w[i]; i += 1  # noqa: E702
+    s = {"mean": w[i], "var": w[i + 1]}
+    return (nn.BatchNormalization(momentum=cfg["momentum"],
+                                  epsilon=cfg["epsilon"],
+                                  center=cfg.get("center", True),
+                                  scale=cfg.get("scale", True)), p, s)
+
+
+def _k_layernorm(layer):
+    cfg = layer.get_config()
+    w = _k_weights(layer)
+    return (nn.LayerNormalization(epsilon=cfg["epsilon"]),
+            {"gamma": w[0], "beta": w[1]}, {})
+
+
+def _k_embedding(layer):
+    cfg = layer.get_config()
+    return (nn.Embedding(cfg["input_dim"], cfg["output_dim"]),
+            {"embeddings": _k_weights(layer)[0]}, {})
+
+
+def _k_pool(cls):
+    def conv(layer):
+        cfg = layer.get_config()
+        return (cls(tuple(cfg["pool_size"]), tuple(cfg["strides"]),
+                    cfg["padding"]), {}, {})
+    return conv
+
+
+def _k_simple(factory):
+    return lambda layer: (factory(layer), {}, {})
+
+
+_TF_CONVERTERS: Dict[str, Callable] = {
+    "Dense": _k_dense,
+    "Conv2D": _k_conv2d,
+    "BatchNormalization": _k_batchnorm,
+    "LayerNormalization": _k_layernorm,
+    "Embedding": _k_embedding,
+    "MaxPooling2D": _k_pool(nn.MaxPooling2D),
+    "AveragePooling2D": _k_pool(nn.AveragePooling2D),
+    "GlobalAveragePooling2D": _k_simple(
+        lambda l: nn.GlobalAveragePooling2D()),
+    "GlobalMaxPooling2D": _k_simple(lambda l: nn.GlobalMaxPooling2D()),
+    "GlobalAveragePooling1D": _k_simple(
+        lambda l: nn.GlobalAveragePooling1D()),
+    "Flatten": _k_simple(lambda l: nn.Flatten()),
+    "Dropout": _k_simple(lambda l: nn.Dropout(l.get_config()["rate"])),
+    "Activation": _k_simple(
+        lambda l: nn.Activation(l.get_config()["activation"])),
+    "ReLU": _k_simple(lambda l: nn.Activation("relu")),
+    "Softmax": _k_simple(lambda l: nn.Activation("softmax")),
+}

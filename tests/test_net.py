@@ -1,0 +1,215 @@
+"""Foreign-model import (VERDICT r1 missing #3): Net.load_torch /
+Net.load_tf, differential-tested against the source framework — the
+reference's TFNetSpec/TorchNetSpec pattern (SURVEY.md §4.4).
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context
+from analytics_zoo_tpu.models import ForeignNet, Net
+
+torch = pytest.importorskip("torch")
+
+
+def _apply(net: ForeignNet, x: np.ndarray) -> np.ndarray:
+    variables = net.init(__import__("jax").random.PRNGKey(0), x)
+    out, _ = net.apply(variables, x)
+    return np.asarray(out)
+
+
+# -- torch --------------------------------------------------------------------
+
+def test_load_torch_mlp_differential():
+    init_orca_context("local")
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(),
+        torch.nn.LayerNorm(16),
+        torch.nn.Linear(16, 4), torch.nn.Tanh())
+    x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+    net = Net.load_torch(tm, x)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_load_torch_convnet_differential():
+    """Conv → BN → pool → flatten → linear: NCHW in, including the
+    Flatten/Linear weight reorder into NHWC order."""
+    init_orca_context("local")
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 6, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.BatchNorm2d(6),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Conv2d(6, 4, 3),            # valid padding
+        torch.nn.Flatten(),
+        torch.nn.Linear(4 * 5 * 5, 10)).eval()
+    # make BN stats non-trivial
+    with torch.no_grad():
+        tm[2].running_mean.uniform_(-0.5, 0.5)
+        tm[2].running_var.uniform_(0.5, 1.5)
+    x = np.random.default_rng(1).normal(size=(4, 3, 14, 14)) \
+        .astype(np.float32)
+    net = Net.load_torch(tm, x)
+    assert net.nchw_input
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-4)
+
+
+def test_load_torch_torchscript_file(tmp_path):
+    init_orca_context("local")
+    tm = torch.nn.Sequential(torch.nn.Linear(4, 3), torch.nn.Sigmoid())
+    path = str(tmp_path / "m.pt")
+    torch.jit.script(tm).save(path)
+    x = np.random.default_rng(2).normal(size=(3, 4)).astype(np.float32)
+    net = Net.load_torch(path, x)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_load_torch_unsupported_layer_names_escape_hatch():
+    init_orca_context("local")
+    tm = torch.nn.Sequential(torch.nn.Linear(4, 4),
+                             torch.nn.MultiheadAttention(4, 2))
+    with pytest.raises(NotImplementedError, match="escape hatch"):
+        Net.load_torch(tm, np.zeros((2, 4), np.float32))
+
+
+def test_torch_params_to_tree():
+    tm = torch.nn.Sequential(torch.nn.Linear(3, 2),
+                             torch.nn.BatchNorm1d(2))
+    tree = Net.torch_params_to_tree(tm)
+    assert tree["0.weight"].shape == (2, 3)
+    assert "1.running_mean" in tree
+
+
+def test_load_torch_finetunes_through_estimator():
+    """The capability JNI bridges never had: imported weights, fine-tuned."""
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    tm = torch.nn.Sequential(torch.nn.Linear(6, 8), torch.nn.ReLU(),
+                             torch.nn.Linear(8, 2))
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = rng.integers(0, 2, 32).astype(np.int32)
+    net = Net.load_torch(tm, x[:2])
+    est = Estimator.from_keras(net, loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2)
+    before = _apply(net, x[:4])
+    with torch.no_grad():
+        np.testing.assert_allclose(before, tm(torch.as_tensor(x[:4])).numpy(),
+                                   atol=1e-5)  # starts AT the torch weights
+    hist = est.fit((x, y), epochs=3, batch_size=16, verbose=False)
+    assert hist["loss"][-1] < hist["loss"][0]  # and actually trains
+
+
+def test_load_torch_head_with_dropout_between_flatten_and_linear():
+    """The kernel reorder must survive order-preserving layers between
+    Flatten and Linear (regression: it used to apply only when Linear
+    immediately followed Flatten)."""
+    init_orca_context("local")
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(2, 3, 3), torch.nn.Flatten(),
+        torch.nn.Dropout(0.5), torch.nn.ReLU(),
+        torch.nn.Linear(3 * 4 * 4, 5)).eval()
+    x = np.random.default_rng(4).normal(size=(2, 2, 6, 6)).astype(np.float32)
+    net = Net.load_torch(tm, x)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_load_torch_conv_ending_net_keeps_torch_layout():
+    """A net ending in conv features must hand back NCHW like the source."""
+    init_orca_context("local")
+    tm = torch.nn.Sequential(torch.nn.Conv2d(3, 5, 3), torch.nn.ReLU())
+    x = np.random.default_rng(5).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    net = Net.load_torch(tm, x)
+    out = _apply(net, x)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    assert out.shape == want.shape == (2, 5, 6, 6)
+    np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_load_torch_exact_gelu():
+    """torch GELU defaults to erf-exact; the conversion must not swap in
+    the tanh approximation."""
+    init_orca_context("local")
+    tm = torch.nn.Sequential(torch.nn.Linear(16, 16), torch.nn.GELU())
+    x = np.random.default_rng(6).normal(size=(8, 16)).astype(np.float32)
+    net = Net.load_torch(tm, x)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-6)
+
+
+# -- tf/keras -----------------------------------------------------------------
+
+
+def test_load_tf_mlp_differential():
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((8,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.LayerNormalization(),
+        tf.keras.layers.Dense(4, activation="softmax")])
+    x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+    net = Net.load_tf(km)
+    want = km(x).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_load_tf_convnet_differential():
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 12, 3)),
+        tf.keras.layers.Conv2D(6, 3, padding="same", activation="relu"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(4, 3, padding="valid"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10)])
+    # non-trivial BN stats
+    bn = km.layers[1]
+    w = bn.get_weights()
+    rng = np.random.default_rng(1)
+    w[2] = rng.normal(0, 0.3, w[2].shape).astype(np.float32)
+    w[3] = rng.uniform(0.5, 1.5, w[3].shape).astype(np.float32)
+    bn.set_weights(w)
+    x = rng.normal(size=(4, 12, 12, 3)).astype(np.float32)
+    net = Net.load_tf(km)
+    want = km(x, training=False).numpy()
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-4)
+
+
+def test_load_tf_from_saved_file(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(3, activation="tanh")])
+    path = str(tmp_path / "model.keras")
+    km.save(path)
+    x = np.random.default_rng(2).normal(size=(3, 6)).astype(np.float32)
+    net = Net.load_tf(path)
+    np.testing.assert_allclose(_apply(net, x), km(x).numpy(), atol=1e-5)
+
+
+def test_load_tf_unsupported_layer_names_escape_hatch():
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((4, 8)),
+        tf.keras.layers.LSTM(4)])
+    with pytest.raises(NotImplementedError, match="escape hatch"):
+        Net.load_tf(km)
+
+
+def test_load_bigdl_documented_drop():
+    with pytest.raises(NotImplementedError, match="consciously dropped"):
+        Net.load_bigdl("whatever")
