@@ -1,18 +1,22 @@
 """Chronos: the time-series toolkit (reference: pyzoo/zoo/chronos —
 SURVEY.md §2.6; named zoo/zouwu in older forks).
 
-TSDataset (pandas feature pipeline), forecasters (LSTM/Seq2Seq/TCN on the
-unified Estimator; ARIMA/Prophet gated on optional CPU deps), anomaly
-detectors (Threshold/AE/DBScan), and AutoTS on the automl package.
+TSDataset (pandas feature pipeline), forecasters (LSTM/Seq2Seq/TCN/MTNet
+on the unified Estimator; TCMF matrix factorization; ARIMA/Prophet gated
+on optional CPU deps), anomaly detectors (Threshold/AE/DBScan), and AutoTS
+on the automl package.
 """
 
 from .data import TSDataset
 from .forecaster import (LSTMForecaster, Seq2SeqForecaster, TCNForecaster,
                          ARIMAForecaster, ProphetForecaster)
+from .mtnet import MTNetForecaster
+from .tcmf import TCMFForecaster
 from .detector import AEDetector, DBScanDetector, ThresholdDetector
 from .autots import AutoTSEstimator, TSPipeline
 
 __all__ = ["TSDataset", "LSTMForecaster", "Seq2SeqForecaster",
-           "TCNForecaster", "ARIMAForecaster", "ProphetForecaster",
+           "TCNForecaster", "MTNetForecaster", "TCMFForecaster",
+           "ARIMAForecaster", "ProphetForecaster",
            "AEDetector", "DBScanDetector", "ThresholdDetector",
            "AutoTSEstimator", "TSPipeline"]

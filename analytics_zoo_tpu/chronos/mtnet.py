@@ -1,0 +1,103 @@
+"""MTNetForecaster: memory time-series network.
+
+Reference (SURVEY.md §2.6): ``pyzoo/zoo/chronos/model/MTNet_keras.py`` —
+MTNet (Chang et al. 2018): a long history is split into ``long_series_num``
+memory blocks of ``series_length`` steps; a CNN+RNN encoder embeds each
+block and the short-term window; attention over the memory embeddings
+against the short-term embedding forms a context; an autoregressive linear
+highway over the raw recent targets is added to the nonlinear output.
+
+TPU-native: one encoder applied to all blocks at once by folding the block
+axis into the batch (shared weights with no parameter duplication, and one
+big MXU-friendly conv/rnn instead of ``long_num`` small ones).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.nn.module import Module, Scope
+from .forecaster import _Forecaster
+
+
+class _MTNet(Module):
+    def __init__(self, long_num: int = 4, time_step: int = 8,
+                 cnn_hid_size: int = 32, rnn_hid_size: int = 32,
+                 cnn_kernel_size: int = 3, ar_window: int = 4,
+                 dropout: float = 0.1, output_dim: int = 1,
+                 horizon: int = 1):
+        super().__init__()
+        self.long_num = long_num
+        self.time_step = time_step
+        self.cnn_hid = cnn_hid_size
+        self.rnn_hid = rnn_hid_size
+        self.k = cnn_kernel_size
+        self.ar_window = ar_window
+        self.dropout = dropout
+        self.output_dim = output_dim
+        self.horizon = horizon
+
+    def forward(self, scope: Scope, x: jnp.ndarray) -> jnp.ndarray:
+        b, total, f = x.shape
+        ln, t = self.long_num, self.time_step
+        if total != (ln + 1) * t:
+            raise ValueError(
+                f"MTNet needs past_seq_len == (long_num+1)*time_step = "
+                f"{(ln + 1) * t}, got {total}")
+        # memory blocks + the short-term window, folded into the batch so
+        # ONE encoder embeds all of them with shared weights
+        blocks = x.reshape(b * (ln + 1), t, f)
+        h = scope.child(nn.Conv1D(self.cnn_hid, self.k, padding="same",
+                                  activation="relu"), blocks, name="enc_cnn")
+        h = scope.child(nn.Dropout(self.dropout), h, name="enc_drop")
+        h = scope.child(nn.GRU(self.rnn_hid, return_sequences=False), h,
+                        name="enc_rnn")                    # [B*(ln+1), H]
+        h = h.reshape(b, ln + 1, self.rnn_hid)
+        memory, short = h[:, :ln], h[:, ln]                # [B,ln,H], [B,H]
+        # attention of the short-term embedding over memory blocks
+        wq = scope.param("attn_w", nn.initializers.get("glorot_uniform"),
+                         (self.rnn_hid, self.rnn_hid))
+        scores = jnp.einsum("blh,hk,bk->bl", memory, wq, short)
+        attn = jax.nn.softmax(scores, axis=-1)
+        context = jnp.einsum("bl,blh->bh", attn, memory)   # [B, H]
+        combined = jnp.concatenate([context, short], axis=-1)
+        out = scope.child(nn.Dense(self.horizon * self.output_dim), combined,
+                          name="head")
+        out = out.reshape(b, self.horizon, self.output_dim)
+        # autoregressive highway on the recent raw targets (first
+        # output_dim features are the targets, TSDataset.roll's layout)
+        ar_in = x[:, -self.ar_window:, : self.output_dim]  # [B, ar, D]
+        ar_in = jnp.swapaxes(ar_in, 1, 2).reshape(b * self.output_dim,
+                                                  self.ar_window)
+        ar = scope.child(nn.Dense(self.horizon, use_bias=False), ar_in,
+                         name="ar")
+        ar = ar.reshape(b, self.output_dim, self.horizon)
+        return out + jnp.swapaxes(ar, 1, 2)
+
+
+class MTNetForecaster(_Forecaster):
+    """Reference API: MTNetForecaster(target_dim, feature_dim,
+    long_series_num, series_length, ...) with fit/predict/evaluate/save/
+    load via the unified estimator.  ``past_seq_len`` must equal
+    (long_series_num + 1) * series_length."""
+
+    MODEL_CLS = _MTNet
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 long_series_num: int = 4, series_length: int = 0,
+                 **kwargs: Any):
+        if series_length == 0:
+            if past_seq_len % (long_series_num + 1):
+                raise ValueError(
+                    f"past_seq_len {past_seq_len} not divisible into "
+                    f"{long_series_num}+1 blocks; pass series_length")
+            series_length = past_seq_len // (long_series_num + 1)
+        kwargs.setdefault("ar_window", min(4, series_length))
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, long_num=long_series_num,
+                         time_step=series_length, **kwargs)

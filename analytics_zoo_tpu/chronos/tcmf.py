@@ -1,0 +1,183 @@
+"""TCMFForecaster: temporal convolutional matrix factorization (DeepGLO).
+
+Reference (SURVEY.md §2.6): ``pyzoo/zoo/chronos/model/tcmf/`` — TCMF
+(Sen et al. 2019 "Think Globally, Act Locally" / DeepGLO): a high-
+dimensional series panel Y [n, T] is factorized as Y ≈ F·X with a small
+temporal basis X [k, T]; a temporal convolution network learns X's
+dynamics and rolls it forward; forecasts are F·X_future.  The reference
+trained it with torch on Spark/Ray workers for scale-out.
+
+TPU-native: the factorization is a jit-compiled alternating gradient
+descent (both factors updated by optax inside one compiled step — the
+panel never leaves the device), and the basis dynamics reuse the chronos
+TCN trunk on the unified Estimator.  API parity: fit(x={"y": ndarray}),
+predict(horizon) → [n, horizon], save/load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.orca.learn import Estimator
+from .forecaster import _TCN
+
+
+class TCMFForecaster:
+    def __init__(self, vbsize: int = 128, hbsize: int = 256, num_channels_X=None,
+                 y_iters: int = 300, rank: int = 8, tcn_lookback: int = 16,
+                 lam: float = 1e-3, lr: float = 5e-2, tcn_lr: float = 1e-3,
+                 seed: int = 0):
+        """``rank``: k, the basis dimension.  vbsize/hbsize kept for
+        reference-API compatibility (batching knobs of the torch impl; the
+        jit path trains the full panel in one program)."""
+        self.rank = rank
+        self.iters = y_iters
+        self.lam = lam
+        self.lr = lr
+        self.tcn_lr = tcn_lr
+        self.tcn_lookback = tcn_lookback
+        self.num_channels_x = list(num_channels_X or (16, 16))
+        self.seed = seed
+        self.F: Optional[np.ndarray] = None      # [n, k]
+        self.X: Optional[np.ndarray] = None      # [k, T]
+        self._tcn_est: Optional[Any] = None
+
+    # -- factorization ---------------------------------------------------------
+
+    def _factorize(self, y: np.ndarray) -> None:
+        n, t = y.shape
+        k = self.rank
+        rng = jax.random.PRNGKey(self.seed)
+        rf, rx = jax.random.split(rng)
+        params = {"F": jax.random.normal(rf, (n, k)) * 0.1,
+                  "X": jax.random.normal(rx, (k, t)) * 0.1}
+        yd = jnp.asarray(y, jnp.float32)
+        tx = optax.adam(self.lr)
+        opt = tx.init(params)
+        lam = self.lam
+
+        @jax.jit
+        def step(params, opt):
+            def loss_fn(p):
+                recon = p["F"] @ p["X"]
+                mse = jnp.mean((recon - yd) ** 2)
+                reg = lam * (jnp.mean(p["F"] ** 2) + jnp.mean(p["X"] ** 2))
+                return mse + reg
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, updates), opt, loss
+
+        # lax-scan the whole optimization into ONE compiled program
+        @jax.jit
+        def run(params, opt):
+            def body(carry, _):
+                p, o = carry
+                p, o, l = step(p, o)
+                return (p, o), l
+
+            (params, opt), losses = jax.lax.scan(body, (params, opt), None,
+                                                 length=self.iters)
+            return params, losses
+
+        params, losses = run(params, opt)
+        self.F = np.asarray(params["F"])
+        self.X = np.asarray(params["X"])
+        self._factor_loss = float(losses[-1])
+
+    # -- public API ------------------------------------------------------------
+
+    def fit(self, x: Dict[str, np.ndarray], val_len: int = 0,
+            epochs: int = 5, batch_size: int = 64) -> float:
+        """``x``: {"y": [n, T] panel}.  Returns the factorization loss."""
+        y = np.asarray(x["y"], np.float32)
+        if y.ndim != 2:
+            raise ValueError(f"y must be [n, T], got {y.shape}")
+        if y.shape[1] <= self.tcn_lookback + 1:
+            raise ValueError(
+                f"series length {y.shape[1]} too short for tcn_lookback="
+                f"{self.tcn_lookback}")
+        self._factorize(y)
+        # train the TCN on the basis: windows of X.T [T, k]
+        xt = self.X.T                                     # [T, k]
+        look = self.tcn_lookback
+        wins = np.stack([xt[i:i + look] for i in
+                         range(len(xt) - look)])          # [N, look, k]
+        nexts = np.stack([xt[i + look][None] for i in
+                          range(len(xt) - look)])         # [N, 1, k]
+        model = _TCN(num_channels=self.num_channels_x, output_dim=self.rank,
+                     horizon=1)
+        self._tcn_est = Estimator.from_keras(model, loss="mse",
+                                             learning_rate=self.tcn_lr,
+                                             seed=self.seed)
+        hist = self._tcn_est.fit((wins, nexts), epochs=epochs,
+                                 batch_size=min(batch_size, len(wins)),
+                                 verbose=False)
+        self._tcn_loss = hist["loss"][-1]
+        return self._factor_loss
+
+    def predict(self, horizon: int = 24) -> np.ndarray:
+        """Roll the basis forward with the TCN; return F @ X_future
+        → [n, horizon]."""
+        if self.F is None or self._tcn_est is None:
+            raise ValueError("fit first")
+        xt = self.X.T.copy()                              # [T, k]
+        steps = []
+        window = xt[-self.tcn_lookback:]
+        for _ in range(horizon):
+            nxt = self._tcn_est.predict(window[None].astype(np.float32),
+                                        batch_size=1)[0, 0]   # [k]
+            steps.append(nxt)
+            window = np.concatenate([window[1:], nxt[None]], axis=0)
+        xf = np.stack(steps, axis=1)                      # [k, horizon]
+        return self.F @ xf
+
+    def evaluate(self, target_value: Dict[str, np.ndarray],
+                 metric=("mae",)) -> Dict[str, float]:
+        y = np.asarray(target_value["y"], np.float32)
+        pred = self.predict(horizon=y.shape[1])
+        err = pred - y
+        out = {}
+        for m in metric:
+            if m == "mae":
+                out["mae"] = float(np.mean(np.abs(err)))
+            elif m == "mse":
+                out["mse"] = float(np.mean(err ** 2))
+            else:
+                raise ValueError(f"unknown metric {m}")
+        return out
+
+    # -- persistence -----------------------------------------------------------
+
+    def save(self, path: str) -> str:
+        if self.F is None:
+            raise ValueError("nothing to save: fit first")
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "factors.npz"), F=self.F, X=self.X)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({"rank": self.rank, "tcn_lookback": self.tcn_lookback,
+                       "num_channels_X": self.num_channels_x}, f)
+        self._tcn_est.save(os.path.join(path, "tcn"))
+        return path
+
+    @staticmethod
+    def load(path: str) -> "TCMFForecaster":
+        with open(os.path.join(path, "config.json")) as f:
+            cfg = json.load(f)
+        fc = TCMFForecaster(rank=cfg["rank"],
+                            tcn_lookback=cfg["tcn_lookback"],
+                            num_channels_X=cfg["num_channels_X"])
+        z = np.load(os.path.join(path, "factors.npz"))
+        fc.F, fc.X = z["F"], z["X"]
+        model = _TCN(num_channels=fc.num_channels_x, output_dim=fc.rank,
+                     horizon=1)
+        fc._tcn_est = Estimator.from_keras(model, loss="mse")
+        fc._tcn_est.load(os.path.join(path, "tcn"))
+        return fc

@@ -28,15 +28,24 @@ BATCH_AXES = ("data", "fsdp")  # mesh axes a batch dim is sharded over
 
 
 def batch_sharding(mesh: Mesh, leaf_rank: int = 1,
-                   seq_dim_size: Optional[int] = None) -> NamedSharding:
+                   seq_dim_size: Optional[int] = None,
+                   dim0_size: Optional[int] = None) -> NamedSharding:
     """NamedSharding that shards dim 0 over the mesh's batch axes.
 
     ``seq_dim_size``: pass the leaf's dim-1 size to ALSO shard dim 1 over the
     mesh's ``seq`` axis (sequence/context parallelism) — applied only to
     feature ('x') leaves whose dim 1 divides the axis; labels and
-    non-divisible shapes stay batch-sharded only."""
+    non-divisible shapes stay batch-sharded only.
+
+    ``dim0_size``: pass the leaf's dim-0 size so a batch that does not
+    divide the batch axes falls back to replicated placement (small
+    inference batches must work on any mesh) instead of erroring."""
     present = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
     dim0 = present if present else None
+    if dim0 is not None and dim0_size is not None:
+        axis_size = int(np.prod([mesh.shape[a] for a in present]))
+        if dim0_size % axis_size != 0:
+            dim0 = None
     seq_ok = (seq_dim_size is not None and leaf_rank >= 2
               and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
               and seq_dim_size % mesh.shape["seq"] == 0)
@@ -69,7 +78,9 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
         leaf = np.asarray(leaf)
         seq_size = leaf.shape[1] if (is_feature and leaf.ndim >= 2) else None
         sharding = batch_sharding(mesh, max(leaf.ndim, 1),
-                                  seq_dim_size=seq_size)
+                                  seq_dim_size=seq_size,
+                                  dim0_size=leaf.shape[0] if leaf.ndim
+                                  else None)
         if multi:
             return jax.make_array_from_process_local_data(sharding, leaf)
         return jax.device_put(leaf, sharding)
