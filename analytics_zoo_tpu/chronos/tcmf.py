@@ -37,17 +37,29 @@ class TCMFForecaster:
         """``rank``: k, the basis dimension.  vbsize/hbsize kept for
         reference-API compatibility (batching knobs of the torch impl; the
         jit path trains the full panel in one program)."""
+        self._config = dict(num_channels_X=list(num_channels_X or (16, 16)),
+                            y_iters=y_iters, rank=rank,
+                            tcn_lookback=tcn_lookback, lam=lam, lr=lr,
+                            tcn_lr=tcn_lr, seed=seed)
         self.rank = rank
         self.iters = y_iters
         self.lam = lam
         self.lr = lr
         self.tcn_lr = tcn_lr
         self.tcn_lookback = tcn_lookback
-        self.num_channels_x = list(num_channels_X or (16, 16))
+        self.num_channels_x = self._config["num_channels_X"]
         self.seed = seed
         self.F: Optional[np.ndarray] = None      # [n, k]
         self.X: Optional[np.ndarray] = None      # [k, T]
         self._tcn_est: Optional[Any] = None
+        self._roll = None                        # cached jitted rollout
+
+    def _make_tcn_estimator(self):
+        model = _TCN(num_channels=self.num_channels_x, output_dim=self.rank,
+                     horizon=1)
+        return Estimator.from_keras(model, loss="mse",
+                                    learning_rate=self.tcn_lr,
+                                    seed=self.seed)
 
     # -- factorization ---------------------------------------------------------
 
@@ -112,11 +124,7 @@ class TCMFForecaster:
                          range(len(xt) - look)])          # [N, look, k]
         nexts = np.stack([xt[i + look][None] for i in
                           range(len(xt) - look)])         # [N, 1, k]
-        model = _TCN(num_channels=self.num_channels_x, output_dim=self.rank,
-                     horizon=1)
-        self._tcn_est = Estimator.from_keras(model, loss="mse",
-                                             learning_rate=self.tcn_lr,
-                                             seed=self.seed)
+        self._tcn_est = self._make_tcn_estimator()
         hist = self._tcn_est.fit((wins, nexts), epochs=epochs,
                                  batch_size=min(batch_size, len(wins)),
                                  verbose=False)
@@ -125,18 +133,35 @@ class TCMFForecaster:
 
     def predict(self, horizon: int = 24) -> np.ndarray:
         """Roll the basis forward with the TCN; return F @ X_future
-        → [n, horizon]."""
+        → [n, horizon].
+
+        The whole autoregressive rollout is ONE compiled program
+        (lax.scan over the horizon, window kept on device) — not a
+        per-step Estimator.predict round-trip."""
         if self.F is None or self._tcn_est is None:
             raise ValueError("fit first")
-        xt = self.X.T.copy()                              # [T, k]
-        steps = []
-        window = xt[-self.tcn_lookback:]
-        for _ in range(horizon):
-            nxt = self._tcn_est.predict(window[None].astype(np.float32),
-                                        batch_size=1)[0, 0]   # [k]
-            steps.append(nxt)
-            window = np.concatenate([window[1:], nxt[None]], axis=0)
-        xf = np.stack(steps, axis=1)                      # [k, horizon]
+        est = self._tcn_est
+        model = est.model
+        if self._roll is None:
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(2,))
+            def roll(ts, window, h):
+                def body(w, _):
+                    out, _ = model.apply(
+                        {"params": ts["params"], "state": ts["state"]},
+                        w, training=False)
+                    nxt = out[:, 0]                        # [1, k]
+                    w = jnp.concatenate([w[:, 1:], nxt[:, None]], axis=1)
+                    return w, nxt[0]
+
+                _, steps = jax.lax.scan(body, window, None, length=h)
+                return steps                               # [h, k]
+
+            self._roll = roll
+        window0 = jnp.asarray(self.X.T[-self.tcn_lookback:],
+                              jnp.float32)[None]           # [1, look, k]
+        xf = np.asarray(self._roll(est._ts, window0, horizon)).T  # [k, h]
         return self.F @ xf
 
     def evaluate(self, target_value: Dict[str, np.ndarray],
@@ -162,8 +187,7 @@ class TCMFForecaster:
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "factors.npz"), F=self.F, X=self.X)
         with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump({"rank": self.rank, "tcn_lookback": self.tcn_lookback,
-                       "num_channels_X": self.num_channels_x}, f)
+            json.dump(self._config, f)
         self._tcn_est.save(os.path.join(path, "tcn"))
         return path
 
@@ -171,13 +195,9 @@ class TCMFForecaster:
     def load(path: str) -> "TCMFForecaster":
         with open(os.path.join(path, "config.json")) as f:
             cfg = json.load(f)
-        fc = TCMFForecaster(rank=cfg["rank"],
-                            tcn_lookback=cfg["tcn_lookback"],
-                            num_channels_X=cfg["num_channels_X"])
+        fc = TCMFForecaster(**cfg)
         z = np.load(os.path.join(path, "factors.npz"))
         fc.F, fc.X = z["F"], z["X"]
-        model = _TCN(num_channels=fc.num_channels_x, output_dim=fc.rank,
-                     horizon=1)
-        fc._tcn_est = Estimator.from_keras(model, loss="mse")
+        fc._tcn_est = fc._make_tcn_estimator()
         fc._tcn_est.load(os.path.join(path, "tcn"))
         return fc

@@ -6,13 +6,29 @@ BigDL protobuf ``saveModule`` round-trips (models/common/ZooModel.scala),
 framework-native torch ``state_dict`` / Keras H5 saves in the Orca estimators,
 and Ray Tune trial checkpoints.  None were sharded; models were single-file.
 
-Here: one mechanism.  A pytree is flattened, leaves gathered to host
-(cross-host leaves allgathered collectively, process 0 writes), written as
-``.npz`` + a JSON treedef; restore
-rebuilds the tree and (optionally) re-shards via ``jax.device_put`` with the
-caller's shardings.  Keeps the reference's "single logical namespace" and adds
-a deterministic layout that round-trips any nested dict/list/tuple of arrays,
-scalars and strings.
+Here: one mechanism.  A pytree is flattened, leaves gathered to host and
+written as ``.npz`` + a JSON treedef; restore rebuilds the tree and
+(optionally) re-shards via ``jax.device_put`` with the caller's shardings.
+Keeps the reference's "single logical namespace" and adds a deterministic
+layout that round-trips any nested dict/list/tuple of arrays, scalars and
+strings.
+
+Multi-host (SURVEY.md §5.4): cross-host-sharded leaves (fsdp/tp over DCN)
+are NOT allgathered to one host — a ZeRO-3 model that doesn't fit a single
+host could never be saved that way.  Instead every process writes the shards
+it owns to its own ``shards_<gen>_p<i>.npz`` (each byte written exactly
+once, by the lowest process holding a replica), and process 0 writes the
+treedef + shard index.  ``restore`` reassembles from the shard files
+(shared filesystem, the TPU norm), per-device when given shardings so no
+host ever materializes a full cross-host leaf; restoring onto a DIFFERENT
+mesh/topology re-tiles shards by overlap.
+
+Crash consistency: every save writes data files under a fresh generation
+tag (broadcast from process 0) and renames ``treedef.json`` — which names
+the generation — last, after a cross-host barrier.  A kill at any point
+leaves the previous checkpoint fully intact (its generation's files are
+never touched); stale generations are garbage-collected only after the new
+meta is visible.
 """
 
 from __future__ import annotations
@@ -31,84 +47,317 @@ _DATA = "arrays.npz"
 
 def _to_host(leaf: Any) -> Any:
     if isinstance(leaf, jax.Array):
-        if not leaf.is_fully_addressable:
-            # Cross-host sharded array (fsdp/model axes over DCN): gather it
-            # to every host first so process 0 can write the full value.
-            from jax.experimental import multihost_utils
-            leaf = multihost_utils.process_allgather(leaf, tiled=True)
         return np.asarray(jax.device_get(leaf))
     return leaf
+
+
+def _npz_safe(arr: np.ndarray) -> tuple:
+    """npz round-trips only builtin numpy dtypes; ml_dtypes (bfloat16,
+    float8_*) come back as raw void '|V<n>'.  Store them as the same-width
+    uint view + the real dtype name for restore."""
+    if arr.dtype.kind != "V":
+        return arr, None
+    name = arr.dtype.name
+    try:
+        view = arr.view(f"uint{8 * arr.dtype.itemsize}")
+    except (TypeError, ValueError) as e:
+        raise TypeError(f"cannot checkpoint dtype {name!r}: {e}") from e
+    return view, name
+
+
+def _from_npz(arr: np.ndarray, name: Optional[str]) -> np.ndarray:
+    return arr if name is None else arr.view(np.dtype(name))
+
+
+def _index_key(idx: tuple, shape: tuple) -> str:
+    """Canonical string for a global-shard index: "s0:e0,s1:e1,..."."""
+    parts = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts) if parts else ":"
+
+
+def _key_to_index(key: str) -> tuple:
+    if key == ":":
+        return ()
+    return tuple(slice(int(a), int(b))
+                 for a, b in (p.split(":") for p in key.split(",")))
 
 
 def save(path: str, tree: Any, step: Optional[int] = None) -> str:
     """Write ``tree`` under directory ``path`` (created if needed).
 
-    Multi-host: every process must call this (cross-host-sharded leaves are
-    allgathered collectively); only process 0 writes.  Returns the directory.
+    Multi-host: every process must call this.  Each process writes ONLY the
+    shards it owns (replica 0 of each shard), so no host ever gathers a
+    cross-host leaf; process 0 additionally writes the treedef + shard
+    index.  Single-host leaves keep the dense single-file layout.  Returns
+    the directory.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if jax.process_count() > 1:
-        host_leaves = [_to_host(l) for l in leaves]  # collective: all procs
-    elif jax.process_index() != 0:
-        return path
-    else:
-        host_leaves = [_to_host(l) for l in leaves]
-
-    if jax.process_index() != 0:
-        return path
+    pidx, pcount = jax.process_index(), jax.process_count()
     os.makedirs(path, exist_ok=True)
 
-    arrays = {}
-    scalars = []
-    for i, leaf in enumerate(host_leaves):
-        if isinstance(leaf, np.ndarray):
-            arrays[f"a{i}"] = leaf
+    arrays: dict = {}        # process-0 dense leaves
+    scalars: list = []       # per-leaf scalar encoding (None for arrays)
+    shard_meta: list = []    # per-leaf: None | {shape, dtype, shards:{key: p}}
+    my_shards: dict = {}     # this process's npz payload for sharded leaves
+    raw_dtypes: dict = {}    # npz key → real dtype name (ml_dtypes leaves)
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # ownership rule (identical on every process, computed not
+            # communicated): each distinct shard index is written by the
+            # LOWEST process index holding a replica of it
+            dmap = leaf.sharding.devices_indices_map(leaf.shape)
+            owners: dict = {}
+            for dev, idx in dmap.items():
+                key = _index_key(idx, leaf.shape)
+                if key not in owners or dev.process_index < owners[key]:
+                    owners[key] = dev.process_index
+            for shard in leaf.addressable_shards:
+                key = _index_key(shard.index, leaf.shape)
+                name = f"a{i}__{key}"
+                if owners[key] == pidx and name not in my_shards:
+                    my_shards[name] = _npz_safe(np.asarray(shard.data))[0]
+            scalars.append(None)
+            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                     "shards": owners}
+            # self-describing layout: record the PartitionSpec so restore
+            # can rebuild the sharding on a target mesh without the caller
+            # spelling out every leaf (Estimator.load uses this)
+            sh = leaf.sharding
+            if isinstance(sh, jax.sharding.NamedSharding):
+                entry["spec"] = [list(e) if isinstance(e, tuple) else e
+                                 for e in sh.spec]
+            shard_meta.append(entry)
+            continue
+        shard_meta.append(None)
+        host = _to_host(leaf) if pidx == 0 else None
+        if pidx != 0:
+            scalars.append(None)
+        elif isinstance(host, np.ndarray):
+            arrays[f"a{i}"], raw = _npz_safe(host)
+            if raw:
+                raw_dtypes[f"a{i}"] = raw
             scalars.append(None)
         else:
-            scalars.append(_encode_scalar(leaf))
+            scalars.append(_encode_scalar(host))
 
-    # Crash-consistent write: stage both files, then rename meta last —
-    # restore() keys off treedef.json, so a kill mid-save leaves either the
-    # complete old checkpoint or the complete new one visible.
-    fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
-    with os.fdopen(fd, "wb") as f:  # np.savez appends .npz to bare paths
-        np.savez(f, **arrays)
-    meta = {
-        "treedef": _treedef_to_json(treedef),
-        "scalars": scalars,
-        "n_leaves": len(host_leaves),
-        "step": step,
-    }
-    fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
-    with os.fdopen(fd, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(path, _DATA))
-    os.replace(tmp_meta, os.path.join(path, _META))
+    # Crash-consistent write: every data file of this save carries a fresh
+    # generation tag; treedef.json (renamed last, after a barrier) names the
+    # generation, so a kill at ANY point leaves the previous checkpoint's
+    # files untouched and its meta still pointing at them.
+    gen = _new_generation(pidx, pcount)
+    if my_shards or pcount > 1:
+        fd, tmp_sh = tempfile.mkstemp(dir=path, suffix=f".p{pidx}.tmp")
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **my_shards)
+        os.replace(tmp_sh, os.path.join(path, _shards_name(gen, pidx)))
+    if pcount > 1:
+        from jax.experimental import multihost_utils
+        # all shard files must be complete before meta becomes visible
+        multihost_utils.sync_global_devices("zoo_ckpt_shards_written")
+    if pidx == 0:
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
+        with os.fdopen(fd, "wb") as f:  # np.savez appends .npz to bare paths
+            np.savez(f, **arrays)
+        meta = {
+            "treedef": _treedef_to_json(treedef),
+            "scalars": scalars,
+            "sharded": shard_meta if any(s is not None for s in shard_meta)
+            else None,
+            "n_leaves": len(leaves),
+            "step": step,
+            "gen": gen,
+            "raw_dtypes": raw_dtypes,
+        }
+        fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(path, _data_name(gen)))
+        os.replace(tmp_meta, os.path.join(path, _META))  # the commit point
+    if pcount > 1:
+        from jax.experimental import multihost_utils
+        # don't let any process see the checkpoint before meta is visible
+        multihost_utils.sync_global_devices("zoo_ckpt_meta_written")
+    if pidx == 0:
+        _gc_stale_generations(path, gen)
     return path
 
 
-def restore(path: str, shardings: Any = None) -> Any:
+def _new_generation(pidx: int, pcount: int) -> str:
+    """A save-wide random tag, agreed on by all processes (broadcast from
+    process 0 over the jax.distributed plane)."""
+    import secrets
+    if pcount == 1:
+        return f"{secrets.randbits(32):08x}"
+    from jax.experimental import multihost_utils
+    local = np.asarray([secrets.randbits(32) if pidx == 0 else 0], np.uint32)
+    return f"{int(multihost_utils.broadcast_one_to_all(local)[0]):08x}"
+
+
+def _data_name(gen: Optional[str]) -> str:
+    return f"arrays_{gen}.npz" if gen else _DATA
+
+
+def _shards_name(gen: Optional[str], proc: int) -> str:
+    return (f"shards_{gen}_p{proc}.npz" if gen else f"shards_p{proc}.npz")
+
+
+def _gc_stale_generations(path: str, live_gen: str) -> None:
+    """Remove data files from superseded saves (only after the new meta is
+    visible; a crash mid-GC just leaves unreferenced files)."""
+    for name in os.listdir(path):
+        if ((name.startswith("arrays_") or name.startswith("shards_"))
+                and name.endswith(".npz") and live_gen not in name):
+            try:
+                os.remove(os.path.join(path, name))
+            except OSError:
+                pass
+
+
+class _ShardFiles:
+    """Cached reads of shards_<gen>_p<i>.npz (shared filesystem).  npz
+    members are decompressed on every [] access, so cache by (proc, key) —
+    replicated leaves would otherwise re-read one member per device."""
+
+    def __init__(self, path: str, gen: Optional[str]):
+        self.path = path
+        self.gen = gen
+        self._open: dict = {}
+        self._arrays: dict = {}
+
+    def get(self, proc: int, key: str) -> np.ndarray:
+        ck = (proc, key)
+        if ck not in self._arrays:
+            if proc not in self._open:
+                self._open[proc] = np.load(
+                    os.path.join(self.path, _shards_name(self.gen, proc)),
+                    allow_pickle=False)
+            self._arrays[ck] = self._open[proc][key]
+        return self._arrays[ck]
+
+
+def _restore_sharded_leaf(files: "_ShardFiles", i: int, entry: dict,
+                          sharding: Any) -> Any:
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    shards = entry["shards"]  # {index_key: owner_process}
+    # ml_dtypes leaves are stored as uint views (see _npz_safe)
+    raw_name = dtype.name if dtype.kind == "V" else None
+
+    def fetch(proc: int, key: str) -> np.ndarray:
+        return _from_npz(files.get(proc, f"a{i}__{key}"), raw_name)
+
+    def piece_for(idx: tuple) -> np.ndarray:
+        """The sub-array for global index ``idx``: a direct shard hit when
+        the boundaries match the save-time tiling, otherwise re-tiled from
+        every overlapping saved shard (restore onto a different mesh)."""
+        key = _index_key(idx, shape)
+        if key in shards:
+            return fetch(int(shards[key]), key)
+        starts = [0 if sl.start is None else sl.start for sl in idx]
+        stops = [dim if sl.stop is None else sl.stop
+                 for sl, dim in zip(idx, shape)]
+        out = np.empty([b - a for a, b in zip(starts, stops)], dtype)
+        filled = 0
+        for skey, proc in shards.items():
+            sidx = _key_to_index(skey)
+            s_starts = [sl.start or 0 for sl in sidx]
+            s_stops = [dim if sl.stop is None else sl.stop
+                       for sl, dim in zip(sidx, shape)]
+            lo = [max(a, sa) for a, sa in zip(starts, s_starts)]
+            hi = [min(b, sb) for b, sb in zip(stops, s_stops)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            src = fetch(int(proc), skey)
+            src_sl = tuple(slice(l - sa, h - sa)
+                           for l, h, sa in zip(lo, hi, s_starts))
+            dst_sl = tuple(slice(l - a, h - a)
+                           for l, h, a in zip(lo, hi, starts))
+            out[dst_sl] = src[src_sl]
+            filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
+        if filled != out.size:
+            raise ValueError(
+                f"checkpoint shards do not cover index {key} of leaf {i} "
+                f"(covered {filled}/{out.size} elements)")
+        return out
+
+    if sharding is None:
+        # no target layout: assemble the dense array on host
+        return piece_for(tuple(slice(0, d) for d in shape))
+    # per-device assembly: this process only reads the pieces its devices
+    # need, so a cross-host (ZeRO-3) leaf is never materialized anywhere
+    dmap = sharding.devices_indices_map(shape)
+    pieces: dict = {}
+    singles = []
+    for dev in sharding.addressable_devices:
+        key = _index_key(dmap[dev], shape)
+        if key not in pieces:
+            pieces[key] = piece_for(dmap[dev])
+        singles.append(jax.device_put(pieces[key], dev))
+    return jax.make_array_from_single_device_arrays(shape, sharding, singles)
+
+
+def _saved_sharding(entry: dict, mesh) -> Any:
+    """Rebuild the save-time NamedSharding on ``mesh`` from the recorded
+    PartitionSpec, or None when the spec is absent/incompatible (leaf then
+    assembles densely and the caller re-places it)."""
+    spec = entry.get("spec")
+    if mesh is None or spec is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    axes = [a for e in spec if e is not None
+            for a in (e if isinstance(e, list) else [e])]
+    if any(a not in mesh.axis_names for a in axes):
+        return None
+    return NamedSharding(mesh, P(*[tuple(e) if isinstance(e, list) else e
+                                   for e in spec]))
+
+
+def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
     """Load the pytree saved at ``path``.
 
     ``shardings``: optional pytree of ``jax.sharding.Sharding`` matching the
     saved structure — when given, leaves are device_put with them (this is how
-    a data-parallel/TP run resumes onto its mesh).
+    a data-parallel/TP run resumes onto its mesh), and cross-host-sharded
+    leaves are assembled per-device without a full-host copy.  The target
+    mesh/topology may differ from the saving one (shards are re-tiled).
+
+    ``mesh``: alternative to ``shardings`` — place each sharded leaf with
+    the PartitionSpec recorded at save time, on this mesh.  Leaves whose
+    spec doesn't fit the mesh assemble densely instead.
     """
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
-    npz = np.load(os.path.join(path, _DATA), allow_pickle=False)
+    npz = np.load(os.path.join(path, _data_name(meta.get("gen"))),
+                  allow_pickle=False)
+    shard_meta = meta.get("sharded") or [None] * meta["n_leaves"]
+    files = _ShardFiles(path, meta.get("gen"))
+    shard_list = (jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: x is None)
+        if shardings is not None else [None] * meta["n_leaves"])
+    if len(shard_list) != meta["n_leaves"]:
+        raise ValueError(
+            f"shardings pytree has {len(shard_list)} leaves, checkpoint has "
+            f"{meta['n_leaves']}")
     leaves = []
     for i in range(meta["n_leaves"]):
         enc = meta["scalars"][i]
-        leaves.append(npz[f"a{i}"] if enc is None else _decode_scalar(enc))
+        s = shard_list[i]
+        if shard_meta[i] is not None:
+            if s is None:
+                s = _saved_sharding(shard_meta[i], mesh)
+            leaves.append(_restore_sharded_leaf(files, i, shard_meta[i], s))
+        elif enc is None:
+            arr = _from_npz(npz[f"a{i}"],
+                            meta.get("raw_dtypes", {}).get(f"a{i}"))
+            leaves.append(jax.device_put(arr, s) if s is not None else arr)
+        else:
+            leaves.append(_decode_scalar(enc))
     treedef = _treedef_from_json(meta["treedef"])
-    tree = jax.tree_util.tree_unflatten(treedef, leaves)
-    if shardings is not None:
-        tree = jax.tree_util.tree_map(
-            lambda leaf, s: jax.device_put(leaf, s) if s is not None else leaf,
-            tree, shardings,
-            is_leaf=lambda x: x is None)
-    return tree
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def latest_step(path: str) -> Optional[int]:

@@ -145,6 +145,16 @@ def init_orca_context(cluster_mode: str = "local",
         logger.setLevel(getattr(logging, cfg.log_level, logging.INFO))
 
         if cluster_mode == "multihost":
+            # zoo-launch (core/launcher.py) passes the topology via env vars,
+            # the same contract as the reference's spark-submit scripts
+            # stuffing master/executor counts into the environment
+            import os as _os
+            if cfg.coordinator_address is None:
+                cfg.coordinator_address = _os.environ.get("ZOO_COORDINATOR")
+            if cfg.num_processes is None and "ZOO_NUM_PROCESSES" in _os.environ:
+                cfg.num_processes = int(_os.environ["ZOO_NUM_PROCESSES"])
+            if cfg.process_id is None and "ZOO_PROCESS_ID" in _os.environ:
+                cfg.process_id = int(_os.environ["ZOO_PROCESS_ID"])
             jax.distributed.initialize(
                 coordinator_address=cfg.coordinator_address,
                 num_processes=cfg.num_processes,

@@ -1,0 +1,120 @@
+"""``zoo-launch``: the multi-process launcher.
+
+Reference (SURVEY.md §2.1/L10): the reference shipped shell launchers
+(scripts/spark-submit-python-with-zoo.sh, jupyter/cluster-serving scripts)
+that assembled a spark-submit command line — cluster bootstrap lived
+outside the library.  On TPU the platform (GKE/QR) normally starts one
+process per host and ``jax.distributed.initialize`` auto-discovers the
+topology; this launcher covers the two cases that still need help:
+
+1. **Simulation** (the default): spawn N local processes, each a
+   ``jax.distributed`` participant with its own CPU devices — the
+   cluster-in-a-box used by the multihost tests and by users validating
+   sharding before burning TPU time.
+2. **Manual clusters**: ``--process-id``/``--coordinator`` run exactly one
+   process of an N-process job on this machine (one invocation per host).
+
+The script's contract with ``init_orca_context("multihost")`` is three env
+vars: ``ZOO_COORDINATOR``, ``ZOO_NUM_PROCESSES``, ``ZOO_PROCESS_ID``.
+
+Usage:
+  zoo-launch --nprocs 2 train.py --epochs 3          # simulate 2 hosts
+  zoo-launch --nprocs 2 --devices-per-proc 4 train.py
+  zoo-launch --nprocs 8 --process-id 3 --coordinator host0:1234 train.py
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(coordinator: str, nprocs: int, pid: int,
+               devices_per_proc: Optional[int], platform: Optional[str]
+               ) -> dict:
+    env = dict(os.environ)
+    env["ZOO_COORDINATOR"] = coordinator
+    env["ZOO_NUM_PROCESSES"] = str(nprocs)
+    env["ZOO_PROCESS_ID"] = str(pid)
+    if devices_per_proc:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{devices_per_proc}")
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        # the environment's TPU plugin hook would override JAX_PLATFORMS
+        if platform == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def launch(script: str, script_args: List[str], nprocs: int,
+           devices_per_proc: Optional[int] = None,
+           coordinator: Optional[str] = None,
+           platform: Optional[str] = None,
+           timeout: Optional[float] = None) -> int:
+    """Spawn ``nprocs`` local processes running ``script``; returns the max
+    exit code.  Output is interleaved (line-buffered) like torchrun."""
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(nprocs):
+        env = _child_env(coordinator, nprocs, pid, devices_per_proc,
+                         platform)
+        procs.append(subprocess.Popen(
+            [sys.executable, script, *script_args], env=env))
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=timeout))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return max(rcs) if rcs else 1
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="zoo-launch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--nprocs", type=int, required=True,
+                        help="total number of processes in the job")
+    parser.add_argument("--devices-per-proc", type=int, default=None,
+                        help="force this many virtual CPU devices per "
+                             "process (simulation)")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0 (default: a free "
+                             "local port)")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="run only this process id (one invocation per "
+                             "host on a real cluster)")
+    parser.add_argument("--platform", default=None,
+                        help="force a jax platform (e.g. cpu for simulation)")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.process_id is not None:
+        if not args.coordinator:
+            parser.error("--process-id requires --coordinator")
+        env = _child_env(args.coordinator, args.nprocs, args.process_id,
+                         args.devices_per_proc, args.platform)
+        os.execve(sys.executable,
+                  [sys.executable, args.script, *args.script_args], env)
+    raise SystemExit(launch(args.script, args.script_args, args.nprocs,
+                            args.devices_per_proc, args.coordinator,
+                            args.platform))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,14 +37,23 @@ def batch_sharding(mesh: Mesh, leaf_rank: int = 1,
     feature ('x') leaves whose dim 1 divides the axis; labels and
     non-divisible shapes stay batch-sharded only.
 
-    ``dim0_size``: pass the leaf's dim-0 size so a batch that does not
-    divide the batch axes falls back to replicated placement (small
-    inference batches must work on any mesh) instead of erroring."""
+    ``dim0_size``: pass the leaf's GLOBAL dim-0 size so a batch that does
+    not divide the batch axes falls back to replicated placement (small
+    inference batches must work on any mesh) instead of erroring.  The
+    fallback is only legal single-process: with multiple processes each
+    host holds different rows, and a "replicated" assembly would silently
+    disagree across hosts — there we raise instead."""
     present = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
     dim0 = present if present else None
     if dim0 is not None and dim0_size is not None:
         axis_size = int(np.prod([mesh.shape[a] for a in present]))
         if dim0_size % axis_size != 0:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"global batch dim {dim0_size} does not divide the "
+                    f"mesh batch axes (size {axis_size}); pick a batch "
+                    "size divisible by the data/fsdp axes in multihost "
+                    "runs (no replicated fallback across processes)")
             dim0 = None
     seq_ok = (seq_dim_size is not None and leaf_rank >= 2
               and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
@@ -77,10 +86,13 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     def place(leaf: np.ndarray, is_feature: bool) -> jax.Array:
         leaf = np.asarray(leaf)
         seq_size = leaf.shape[1] if (is_feature and leaf.ndim >= 2) else None
+        # dim0_size must be the GLOBAL batch: each process contributes an
+        # equal local slice, so global = local * process_count
+        dim0 = (leaf.shape[0] * jax.process_count() if multi
+                else leaf.shape[0]) if leaf.ndim else None
         sharding = batch_sharding(mesh, max(leaf.ndim, 1),
                                   seq_dim_size=seq_size,
-                                  dim0_size=leaf.shape[0] if leaf.ndim
-                                  else None)
+                                  dim0_size=dim0)
         if multi:
             return jax.make_array_from_process_local_data(sharding, leaf)
         return jax.device_put(leaf, sharding)

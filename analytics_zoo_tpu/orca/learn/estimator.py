@@ -332,21 +332,29 @@ class ZooEstimator:
 
     def load(self, path: Optional[str] = None) -> None:
         path = path or self.model_dir
-        tree = ckpt_io.restore(path)
         mesh = get_mesh()
+        # mesh-aware restore: leaves that were sharded at save time come
+        # back already placed under their recorded PartitionSpec — a
+        # cross-host (ZeRO-3) checkpoint is never densely assembled
+        tree = ckpt_io.restore(path, mesh=mesh)
         self._py_step = int(np.asarray(tree["step"]))
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
+
+        def place(leaf, spec):
+            if isinstance(leaf, jax.Array):
+                return leaf  # restored on-mesh under the saved layout
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
         if rules:
             # restore under the SAME layout training uses (a plain replicated
             # device_put would silently drop tp/fsdp sharding)
             from analytics_zoo_tpu.parallel import infer_param_specs
             specs = infer_param_specs(tree["params"], rules, mesh)
-            params = jax.tree_util.tree_map(
-                lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
-                tree["params"], specs)
+            params = jax.tree_util.tree_map(place, tree["params"], specs)
         else:
-            params = jax.device_put(tree["params"], replicated)
+            params = jax.tree_util.tree_map(
+                lambda l: place(l, P()), tree["params"])
         # checkpoint IO stores optax named-tuples as plain tuples; rebuild the
         # real structure (and its shardings) from tx.init and pour leaves in
         ref_opt = _ensure_on_mesh(jax.jit(self.tx.init)(params), mesh)

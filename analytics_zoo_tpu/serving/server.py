@@ -211,3 +211,46 @@ class ClusterServing:
                 protocol.send_frame(p.conn, protocol.encode(header, arr))
         except OSError:
             pass  # client went away
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """``zoo-serving`` launcher (reference: the cluster-serving-start script
+    + config.yaml, scripts/cluster-serving/).  Loads a ``ZooModel.save_model``
+    directory, starts the TCP service and, optionally, the HTTP frontend."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(prog="zoo-serving",
+                                     description=main.__doc__)
+    parser.add_argument("--model-dir", required=True,
+                        help="a ZooModel.save_model directory")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8980)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--http-port", type=int, default=None,
+                        help="also serve HTTP/JSON on this port")
+    args = parser.parse_args(argv)
+
+    model = InferenceModel().load_zoo_model(args.model_dir)
+    serving = ClusterServing(model, host=args.host, port=args.port,
+                             batch_size=args.batch_size).start()
+    frontend = None
+    if args.http_port is not None:
+        from .http_frontend import HTTPFrontend
+        frontend = HTTPFrontend(serving_host=serving.host,
+                                serving_port=serving.port,
+                                host=args.host, port=args.http_port).start()
+        logger.info("HTTP frontend on %s:%d", args.host, frontend.port)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        if frontend is not None:
+            frontend.stop()
+        serving.stop()
+
+
+if __name__ == "__main__":
+    main()

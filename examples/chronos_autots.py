@@ -1,0 +1,75 @@
+"""Chronos AutoTS example (reference:
+pyzoo/zoo/examples/chronos/... + the AutoTS quickstart in the reference
+docs: TSDataset → AutoTSEstimator.fit → TSPipeline).
+
+Searches over forecaster families + hyperparameters on a synthetic
+daily-seasonality series, then predicts with the winning TSPipeline and
+round-trips it through save/load.
+
+Run:  python examples/chronos_autots.py --epochs 2 --n-sampling 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+
+def synthetic_series(n: int = 600, seed: int = 0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    t = np.arange(n)
+    value = (10.0 + 3.0 * np.sin(2 * np.pi * t / 24)
+             + 0.01 * t + rng.normal(0, 0.3, n))
+    return pd.DataFrame({
+        "timestamp": pd.date_range("2026-01-01", periods=n, freq="h"),
+        "value": value.astype(np.float32),
+    })
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--n-sampling", type=int, default=2)
+    parser.add_argument("--lookback", type=int, default=24)
+    parser.add_argument("--horizon", type=int, default=4)
+    args = parser.parse_args()
+
+    from analytics_zoo_tpu.chronos import (AutoTSEstimator, TSDataset,
+                                           TSPipeline)
+    from analytics_zoo_tpu.core import init_orca_context, stop_orca_context
+
+    init_orca_context("local")
+    try:
+        df = synthetic_series()
+        train, _, test = TSDataset.from_pandas(
+            df, dt_col="timestamp", target_col="value", with_split=True,
+            test_ratio=0.1)
+        train.scale()
+        test.scale(train.scaler, fit=False)
+
+        auto = AutoTSEstimator(model=["lstm", "tcn"],
+                               past_seq_len=args.lookback,
+                               future_seq_len=args.horizon)
+        pipeline = auto.fit(train, epochs=args.epochs,
+                            n_sampling=args.n_sampling)
+        print(f"best config: {auto.best_config}")
+
+        test.roll(args.lookback, args.horizon)
+        x_test, y_test = test.to_numpy()
+        metrics = pipeline.evaluate((x_test, y_test))
+        print(f"test metrics: {metrics}")
+
+        with tempfile.TemporaryDirectory() as d:
+            pipeline.save(d)
+            reloaded = TSPipeline.load(d)
+            pred = reloaded.predict(x_test[:4])
+            print(f"reloaded prediction shape: {pred.shape}")
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Worker for the 2-process jax.distributed test (run via zoo-launch).
+
+Exercises every multihost-only code path end-to-end on a CPU
+cluster-in-a-box (SURVEY.md §4's contract — the reference tested its
+distributed layer on clusters-in-a-box, not mocks):
+
+- ``init_orca_context("multihost")`` → jax.distributed.initialize from the
+  ZOO_* env vars the launcher sets
+- per-process data → ``make_array_from_process_local_data`` (data/feed.py)
+- fsdp parameter sharding ACROSS processes (leaves not fully addressable)
+- jit train/eval steps whose reductions are global (identical metrics on
+  every process, no host-local sums)
+- per-host sharded checkpoint save + restore (core/checkpoint.py)
+
+Prints "MULTIHOST_OK <eval_loss>" from every process on success.
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1]
+    import jax
+
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.core import checkpoint as ckpt_io
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    init_orca_context("multihost", mesh_shape={"data": 1, "fsdp": 0})
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    model = nn.Sequential([
+        nn.Dense(32, activation="relu"),
+        nn.Dense(32, activation="relu"),
+        nn.Dense(2),
+    ])
+
+    # identical global dataset on both processes; each contributes its half
+    rng = np.random.default_rng(0)
+    x_all = rng.normal(size=(64, 8)).astype(np.float32)
+    y_all = (x_all.sum(axis=1) > 0).astype(np.int32)
+    lo, hi = pid * 32, (pid + 1) * 32
+    x_loc, y_loc = x_all[lo:hi], y_all[lo:hi]
+
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2, sharding="fsdp",
+                               metrics=["accuracy"])
+    hist = est.fit((x_loc, y_loc), epochs=2, batch_size=16, verbose=False)
+    assert np.isfinite(hist["loss"][-1]), hist
+
+    # fsdp over 4 devices spanning 2 processes → params must be sharded
+    # across hosts, or the whole point of the test is lost
+    kernel = next(l for l in jax.tree_util.tree_leaves(est._ts["params"])
+                  if l.ndim == 2)
+    assert not kernel.is_fully_addressable, kernel.sharding
+
+    before = est.evaluate((x_loc, y_loc), batch_size=16)
+    assert np.isfinite(before["loss"]), before
+
+    est.save(ckpt_dir)
+
+    # fresh estimator; restore must reproduce the eval exactly
+    est2 = Estimator.from_keras(model,
+                                loss="sparse_categorical_crossentropy",
+                                learning_rate=1e-2, sharding="fsdp",
+                                metrics=["accuracy"])
+    est2.load(ckpt_dir)
+    after = est2.evaluate((x_loc, y_loc), batch_size=16)
+    assert abs(after["loss"] - before["loss"]) < 1e-5, (before, after)
+    assert abs(after["accuracy"] - before["accuracy"]) < 1e-6, (before, after)
+
+    # direct sharded-restore path: per-device assembly under the live layout
+    tree = ckpt_io.restore(ckpt_dir, shardings=jax.tree_util.tree_map(
+        lambda l: l.sharding if hasattr(l, "sharding") else None,
+        est._ts, is_leaf=lambda x: x is None))
+    k2 = next(l for l in jax.tree_util.tree_leaves(tree["params"])
+              if l.ndim == 2)
+    np.testing.assert_allclose(
+        np.asarray(k2.addressable_shards[0].data),
+        np.asarray(kernel.addressable_shards[0].data), rtol=0, atol=0)
+
+    # restore onto a DIFFERENT layout than saved: fsdp-sharded shards must
+    # be re-tiled to a fully-replicated target (topology-change resume)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from analytics_zoo_tpu.core import get_mesh
+    repl = NamedSharding(get_mesh(), P())
+    tree_r = ckpt_io.restore(ckpt_dir, shardings=jax.tree_util.tree_map(
+        lambda l: repl, est._ts, is_leaf=lambda x: x is None))
+    dense = ckpt_io.restore(ckpt_dir)  # host-side dense assembly
+    k_rep = next(l for l in jax.tree_util.tree_leaves(tree_r["params"])
+                 if l.ndim == 2)
+    k_dense = next(l for l in jax.tree_util.tree_leaves(dense["params"])
+                   if getattr(l, "ndim", 0) == 2)
+    np.testing.assert_array_equal(
+        np.asarray(k_rep.addressable_shards[0].data), k_dense)
+
+    print(f"MULTIHOST_OK {after['loss']:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

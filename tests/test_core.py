@@ -120,6 +120,30 @@ def test_checkpoint_jax_arrays(tmp_path):
     np.testing.assert_array_equal(back["w"], np.ones((2, 2)) * 3)
 
 
+def test_checkpoint_bfloat16_roundtrip(tmp_path):
+    # npz alone degrades ml_dtypes to raw void; the uint-view encoding must
+    # bring back real bfloat16 (the TPU-default training dtype)
+    tree = {"w": jnp.asarray([[1.5, -2.0], [0.25, 3.0]], jnp.bfloat16),
+            "f8": jnp.asarray([1.0, 0.5], jnp.float8_e4m3fn)}
+    path = checkpoint.save(str(tmp_path / "c"), tree)
+    back = checkpoint.restore(path)
+    assert back["w"].dtype == jnp.bfloat16
+    assert back["f8"].dtype == jnp.float8_e4m3fn
+    np.testing.assert_array_equal(np.asarray(back["w"], np.float32),
+                                  [[1.5, -2.0], [0.25, 3.0]])
+
+
+def test_checkpoint_repeated_save_gc(tmp_path):
+    d = str(tmp_path / "c")
+    for k in range(3):
+        checkpoint.save(d, {"w": np.full((2,), k, np.float32)}, step=k)
+    back = checkpoint.restore(d)
+    assert back["w"][0] == 2 and checkpoint.latest_step(d) == 2
+    import os
+    npzs = [n for n in os.listdir(d) if n.endswith(".npz")]
+    assert len(npzs) == 1, npzs  # stale generations garbage-collected
+
+
 def test_summary_writer(tmp_path):
     from analytics_zoo_tpu.core import SummaryWriter
     w = SummaryWriter(str(tmp_path), "train")

@@ -1,0 +1,46 @@
+"""Examples-as-tests (reference pattern: SURVEY.md §4.5 — the reference ran
+pyzoo/zoo/examples/* at toy scale in its integration CI so the documented
+entry points could never rot).  Each example is executed as a real
+subprocess — the same way a user would run it — at the smallest scale that
+still exercises the full path."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script: str, *args: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+def test_lenet_example():
+    proc = _run("lenet_mnist.py", "--epochs", "1", "--samples", "128",
+                "--batch-size", "32")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "validation:" in proc.stdout
+
+
+def test_bert_finetune_example():
+    proc = _run("bert_finetune.py", "--epochs", "1", "--samples", "64",
+                "--batch-size", "16", "--seq-len", "32", "--hidden", "64",
+                "--layers", "1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "validation:" in proc.stdout
+
+
+def test_chronos_autots_example():
+    pytest.importorskip("pandas")
+    proc = _run("chronos_autots.py", "--epochs", "1", "--n-sampling", "1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "test metrics:" in proc.stdout
+    assert "reloaded prediction shape:" in proc.stdout
