@@ -20,6 +20,7 @@ Top-level subpackages (mirroring the reference's layer map, SURVEY.md §1):
 - ``core``      — context bootstrap, mesh, config, checkpoint, logging  (L3)
 - ``data``      — XShards host-sharded data + readers + device feed     (L4)
 - ``nn``        — Keras-style layer API on a minimal JAX module system  (L5)
+- ``nnframes``  — DataFrame-native NNEstimator/NNModel (Spark-ML analog)(L5)
 - ``orca``      — the unified Estimator (fit/evaluate/predict/save/load)(L6)
 - ``orca.automl`` — hp search-space DSL + search engines + AutoEstimator(L7)
 - ``chronos``   — time-series toolkit: TSDataset, forecasters, AutoTS   (L8)

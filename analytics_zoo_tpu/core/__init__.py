@@ -4,10 +4,11 @@ from .config import MeshConfig, ZooConfig
 from .context import (OrcaContext, get_mesh, init_nncontext,
                       init_orca_context, make_mesh, stop_orca_context)
 from . import checkpoint
+from .failover import Preempted, PreemptionGuard
 from .summary import SummaryWriter
 
 __all__ = [
     "MeshConfig", "ZooConfig", "OrcaContext", "get_mesh", "init_nncontext",
     "init_orca_context", "make_mesh", "stop_orca_context", "checkpoint",
-    "SummaryWriter",
+    "SummaryWriter", "Preempted", "PreemptionGuard",
 ]

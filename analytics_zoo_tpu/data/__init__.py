@@ -7,6 +7,8 @@ from .stream import StreamingDataFeed
 from .image import (ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop,
                     ImageRandomFlip, ImageNormalize)
 from .text import TextSet
+from .interop import (IterableDataFeed, from_iterator, from_tf_dataset,
+                      from_torch_dataset, from_torch_dataloader)
 
 # reference-parity namespace: zoo.orca.data.pandas.read_csv
 from . import readers as pandas  # noqa: F401
@@ -16,4 +18,6 @@ __all__ = [
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
     "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
     "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "TextSet",
+    "IterableDataFeed", "from_iterator", "from_tf_dataset",
+    "from_torch_dataset", "from_torch_dataloader",
 ]

@@ -147,6 +147,17 @@ class FeedBase:
             sel = np.resize(sel, self._local_batch)
         return sel
 
+    def step_mask(self, step: int) -> np.ndarray:
+        """Real-row weights for this process's ``step`` batch: 1.0 for rows
+        that exist, 0.0 for padding (only the last non-drop_remainder batch
+        is ever padded).  Lets a jit-compiled eval step cover the tail rows
+        exactly under static shapes."""
+        real = min(self._local_batch,
+                   max(0, self._n - step * self._local_batch))
+        m = np.zeros((self._local_batch,), np.float32)
+        m[:real] = 1.0
+        return m
+
 
 class DataFeed(FeedBase):
     """An epoch-iterable source of device-resident, mesh-sharded batches,

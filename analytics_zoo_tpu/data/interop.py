@@ -1,0 +1,229 @@
+"""Foreign input-pipeline interop: tf.data, torch Dataset/DataLoader, and
+plain Python iterables → mesh-sharded device feeds.
+
+Reference (SURVEY.md §2.2): "orca TF Dataset" wrapped ``tf.data.Dataset``
+for the TF estimators (pyzoo/zoo/orca/data/tf/data.py), TFPark's
+``TFDataset`` fed per-worker queues, and the torch estimators took
+``data_creator`` functions returning DataLoaders
+(pyzoo/zoo/orca/learn/pytorch/).  Each framework owned its own feeding
+stack.
+
+TPU-native collapse: every foreign source becomes one of two feeds —
+
+- map-style sources (torch ``Dataset.__getitem__``) ride
+  ``StreamingDataFeed``: native-queue prefetch, worker threads, step-order
+  delivery — the full input pipeline, with the foreign object only
+  supplying ``load_sample``;
+- stream-style sources (``tf.data.Dataset``, generators, torch
+  ``IterableDataset``) ride ``IterableDataFeed``: re-batched to the global
+  batch, final partial batch padded + masked so evaluate stays exact.
+
+TensorFlow is NOT a dependency: ``from_tf_dataset`` imports it lazily and
+raises a clear error when absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .feed import FeedBase, shard_batch
+
+
+def _as_sample_dict(elem: Any) -> Dict[str, Any]:
+    if isinstance(elem, dict):
+        return elem
+    if isinstance(elem, (tuple, list)):
+        if len(elem) == 2:
+            return {"x": elem[0], "y": elem[1]}
+        if len(elem) == 1:
+            return {"x": elem[0]}
+        raise ValueError(
+            f"sample tuples must be (x,) or (x, y); got {len(elem)} items")
+    return {"x": elem}
+
+
+class IterableDataFeed(FeedBase):
+    """Unknown-length sample stream → fixed-shape device batches.
+
+    ``make_iter(epoch_idx)`` returns a fresh iterator of samples (dicts,
+    (x, y) tuples, or bare arrays).  The final partial batch is padded to
+    the static shape and carries a ``mask`` entry weighting padding rows 0
+    (Estimator.evaluate consumes it for exact metrics); with
+    ``drop_remainder`` the tail is dropped instead.  After one pass the
+    true row count is known (``num_rows``), which Estimator.predict reads
+    after iterating."""
+
+    def __init__(self, make_iter: Callable[[int], Iterator[Any]],
+                 batch_size: int, drop_remainder: bool = False,
+                 seed: int = 0, pre_sharded: bool = False):
+        """``pre_sharded``: the iterator already yields only THIS process's
+        samples (e.g. a tf.data pipeline with ``.shard(...)``).  Default
+        False: in multihost runs each process strides the shared stream
+        (keeps sample ``i`` iff ``i %% process_count == process_index``) so
+        the assembled global batch holds each sample exactly once."""
+        super().__init__(num_samples=0, batch_size=batch_size,
+                         shuffle=False, seed=seed,
+                         drop_remainder=drop_remainder)
+        self._make_iter = make_iter
+        self.pre_sharded = pre_sharded
+
+    def steps_per_epoch(self) -> int:
+        if self._n:
+            return super().steps_per_epoch()
+        return -1  # unknown until one pass completes
+
+    def remainder(self) -> Optional[Dict[str, np.ndarray]]:
+        return None  # the padded+masked final batch covers the tail
+
+    def step_mask(self, step: int) -> np.ndarray:
+        # masks are attached by epoch() itself (length unknown up front)
+        return np.ones((self._local_batch,), np.float32)
+
+    def epoch(self, mesh: Mesh, epoch_idx: int = 0
+              ) -> Iterator[Dict[str, Any]]:
+        import jax as _jax
+        multi = _jax.process_count() > 1
+        it = self._make_iter(epoch_idx)
+        if not self.pre_sharded and multi:
+            pidx, pcount = _jax.process_index(), _jax.process_count()
+            it = (e for i, e in enumerate(it) if i % pcount == pidx)
+        lb = self._local_batch
+        count = 0
+        pending = None
+        last_row: Any = None
+        exhausted = False
+
+        def flush(batch_rows, n_real, include_mask):
+            batch = {k: np.stack([np.asarray(r[k]) for r in batch_rows])
+                     for k in batch_rows[0]}
+            if include_mask:
+                m = np.zeros((len(batch_rows),), np.float32)
+                m[:n_real] = 1.0
+                batch["mask"] = m
+            return shard_batch(batch, mesh)
+
+        while True:
+            rows: list = []
+            while len(rows) < lb and not exhausted:
+                try:
+                    rows.append(_as_sample_dict(next(it)))
+                    count += 1
+                except StopIteration:
+                    exhausted = True
+            n_real = len(rows)
+            if multi:
+                # SPMD consensus: every process must emit the same number
+                # of (global) batches — and agree on the batch STRUCTURE
+                # (mask present or not) — even when stream lengths differ;
+                # a process that ran dry emits all-masked filler batches
+                # until the slowest stream finishes
+                from jax.experimental import multihost_utils
+                reals = multihost_utils.process_allgather(
+                    np.asarray([n_real], np.int32))
+                if int(np.max(reals)) == 0:
+                    break
+                if n_real == 0 and last_row is None:
+                    raise ValueError(
+                        "a process received zero samples while others have "
+                        "data; give every host samples (or use "
+                        "pre_sharded=False striding)")
+                include_mask = int(np.min(reals)) < lb
+            elif n_real == 0:
+                break
+            else:
+                include_mask = n_real < lb
+            if include_mask and self.drop_remainder and not multi:
+                break
+            if n_real < lb:
+                filler = rows[-1] if rows else last_row
+                rows = rows + [filler] * (lb - n_real)
+            last_row = rows[-1]
+            if pending is not None:
+                yield pending  # one-batch lookahead, like DataFeed
+            pending = flush(rows, n_real, include_mask)
+            if exhausted and not multi:
+                break
+        self._n = count
+        if pending is not None:
+            yield pending
+
+
+def from_iterator(make_iter: Callable[[int], Iterator[Any]],
+                  batch_size: int, **kw: Any) -> IterableDataFeed:
+    """Generic stream → feed.  ``make_iter(epoch_idx)`` yields samples."""
+    return IterableDataFeed(make_iter, batch_size, **kw)
+
+
+def from_tf_dataset(dataset: Any, batch_size: int, batched: bool = False,
+                    **kw: Any) -> IterableDataFeed:
+    """``tf.data.Dataset`` → feed.
+
+    Elements map like any sample: dict passthrough, (x, y) tuple, or a
+    single tensor.  Pass ``batched=True`` for a dataset that already went
+    through ``.batch(...)`` — it is unbatched and re-batched to the GLOBAL
+    batch (multihost semantics tf can't know about).  No shape-based
+    guessing: a leading None dim also legitimately means ragged sequences.
+    Re-iterated per epoch, so shuffling/augmentation inside the tf pipeline
+    re-applies each epoch."""
+    try:
+        import tensorflow as tf  # noqa: F401  (optional dependency)
+    except ImportError as e:
+        raise ImportError(
+            "from_tf_dataset needs tensorflow installed "
+            "(pip install analytics-zoo-tpu[tf])") from e
+    if batched:
+        dataset = dataset.unbatch()
+
+    def make_iter(epoch_idx: int):
+        return iter(dataset.as_numpy_iterator())
+
+    return IterableDataFeed(make_iter, batch_size, **kw)
+
+
+def from_torch_dataset(dataset: Any, batch_size: int, shuffle: bool = True,
+                       num_workers: int = 4, seed: int = 0,
+                       **kw: Any):
+    """Map-style ``torch.utils.data.Dataset`` → StreamingDataFeed (native-
+    queue prefetch + worker threads run ``dataset[i]`` off the critical
+    path).  Iterable-style datasets go through ``from_iterator``."""
+    if hasattr(dataset, "__getitem__") and hasattr(dataset, "__len__"):
+        from .stream import StreamingDataFeed
+
+        def load_sample(i: int, rng=None) -> Dict[str, np.ndarray]:
+            return _to_numpy_sample(dataset[i])
+
+        return StreamingDataFeed(len(dataset), load_sample, batch_size,
+                                 shuffle=shuffle, num_workers=num_workers,
+                                 seed=seed, **kw)
+    return IterableDataFeed(lambda e: iter(dataset), batch_size,
+                            seed=seed, **kw)
+
+
+def from_torch_dataloader(loader: Any, batch_size: Optional[int] = None,
+                          **kw: Any) -> IterableDataFeed:
+    """``torch.utils.data.DataLoader`` → feed.  The loader's own batching
+    is flattened back to samples, then re-batched to the GLOBAL batch
+    (multihost semantics the loader can't know about)."""
+    bs = batch_size or getattr(loader, "batch_size", None) or 32
+
+    def make_iter(epoch_idx: int):
+        for batch in loader:
+            sample = _to_numpy_sample(batch)
+            n = len(next(iter(sample.values())))
+            for i in range(n):
+                yield {k: v[i] for k, v in sample.items()}
+
+    return IterableDataFeed(make_iter, bs, **kw)
+
+
+def _to_numpy_sample(elem: Any) -> Dict[str, np.ndarray]:
+    def to_np(v):
+        if hasattr(v, "detach"):  # torch tensor
+            return v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    sample = _as_sample_dict(elem)
+    return {k: to_np(v) for k, v in sample.items()}

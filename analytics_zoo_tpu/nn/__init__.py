@@ -9,6 +9,18 @@ from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      GlobalMaxPooling1D, GlobalMaxPooling2D, Lambda,
                      LayerNormalization, MaxPooling2D, Multiply, Reshape,
                      Sequential, ZeroPadding2D)
+from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
+                           Conv2DTranspose, Conv3D, Cropping1D, Cropping2D,
+                           DepthwiseConv2D, Dot, ELU, GaussianDropout,
+                           GaussianNoise, GlobalAveragePooling3D,
+                           GlobalMaxPooling3D, Highway, LeakyReLU,
+                           LocallyConnected1D, Masking, MaxoutDense,
+                           MaxPooling1D, MaxPooling3D, Maximum, Minimum,
+                           Permute, PReLU, RepeatVector, SeparableConv2D,
+                           SpatialDropout1D, SpatialDropout2D,
+                           SpatialDropout3D, Subtract, ThresholdedReLU,
+                           UpSampling1D, UpSampling2D, UpSampling3D,
+                           ZeroPadding1D, ZeroPadding3D)
 from .module import Module, Scope, param_count
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
 
@@ -22,4 +34,15 @@ __all__ = [
     "LayerNormalization", "Concatenate", "Add", "Multiply", "Sequential",
     "LSTM", "GRU", "SimpleRNN", "Bidirectional", "TimeDistributed",
     "MultiHeadAttention", "TransformerLayer", "dot_product_attention",
+    # extended Keras-1.2 zoo (layers_extra)
+    "Conv3D", "Conv2DTranspose", "DepthwiseConv2D", "SeparableConv2D",
+    "LocallyConnected1D", "MaxPooling1D", "AveragePooling1D",
+    "MaxPooling3D", "AveragePooling3D", "GlobalAveragePooling3D",
+    "GlobalMaxPooling3D", "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "ZeroPadding1D", "ZeroPadding3D", "Cropping1D", "Cropping2D",
+    "RepeatVector", "Permute", "Masking", "SpatialDropout1D",
+    "SpatialDropout2D", "SpatialDropout3D", "GaussianNoise",
+    "GaussianDropout", "LeakyReLU", "ELU", "ThresholdedReLU", "PReLU",
+    "Average", "Maximum", "Minimum", "Subtract", "Dot", "Highway",
+    "MaxoutDense",
 ]

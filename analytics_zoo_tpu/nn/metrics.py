@@ -4,9 +4,11 @@ pyzoo/zoo/orca/learn/metrics.py — Accuracy, Top5Accuracy, Loss, MAE, MSE, AUC)
 Design: a metric is a pair of pure functions so it jit-compiles inside the
 eval step and aggregates exactly across sharded batches:
 
-- ``update(y_pred, y_true) -> stats``: per-batch sufficient statistics
-  (e.g. (correct_count, total)); summed across batches/devices by the
-  estimator (a psum when sharded).
+- ``update(y_pred, y_true, mask=None) -> stats``: per-batch sufficient
+  statistics (e.g. (correct_count, total)); summed across batches/devices
+  by the estimator (a psum when sharded).  ``mask`` [batch] weights each
+  example (0.0 = padding row) so the estimator can evaluate a padded final
+  batch exactly — required for static shapes under jit.
 - ``result(stats) -> float``: final value from summed statistics.
 """
 
@@ -18,10 +20,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _ones_mask(y_pred: jax.Array, mask) -> jax.Array:
+    if mask is None:
+        return jnp.ones((y_pred.shape[0],), jnp.float32)
+    return mask.astype(jnp.float32)
+
+
 class Metric:
     name: str = "metric"
 
-    def update(self, y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    def update(self, y_pred: jax.Array, y_true: jax.Array,
+               mask: jax.Array = None) -> jax.Array:
         raise NotImplementedError
 
     def result(self, stats: jax.Array) -> jax.Array:
@@ -34,7 +43,8 @@ class Accuracy(Metric):
 
     name = "accuracy"
 
-    def update(self, y_pred, y_true):
+    def update(self, y_pred, y_true, mask=None):
+        m = _ones_mask(y_pred, mask)
         if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
             pred = jnp.argmax(y_pred, axis=-1)
             true = (jnp.argmax(y_true, axis=-1)
@@ -43,10 +53,12 @@ class Accuracy(Metric):
             pred = (y_pred.reshape(y_pred.shape[0], -1)[:, 0] > 0).astype(
                 jnp.int32)
             true = y_true.reshape(y_true.shape[0], -1)[:, 0]
-        correct = (pred.astype(jnp.int32) == true.astype(jnp.int32)).sum()
-        total = jnp.asarray(pred.shape[0], jnp.int32)
-        return jnp.stack([correct.astype(jnp.float32),
-                          total.astype(jnp.float32)])
+        hit = (pred.astype(jnp.int32) == true.astype(jnp.int32))
+        # multi-position outputs (e.g. [B, T] token predictions) score each
+        # example by its fraction of correct positions
+        hit = hit.reshape(hit.shape[0], -1).mean(axis=-1,
+                                                 dtype=jnp.float32)
+        return jnp.stack([(hit * m).sum(), m.sum()])
 
     def result(self, stats):
         return stats[0] / jnp.maximum(stats[1], 1.0)
@@ -57,37 +69,52 @@ class TopKAccuracy(Metric):
         self.k = k
         self.name = f"top{k}_accuracy"
 
-    def update(self, y_pred, y_true):
+    def update(self, y_pred, y_true, mask=None):
+        m = _ones_mask(y_pred, mask)
         _, topk = jax.lax.top_k(y_pred, self.k)
         true = (jnp.argmax(y_true, axis=-1)
                 if y_true.ndim == y_pred.ndim else y_true)
-        correct = (topk == true[..., None].astype(topk.dtype)).any(-1).sum()
-        return jnp.stack([correct.astype(jnp.float32),
-                          jnp.asarray(y_pred.shape[0], jnp.float32)])
+        hit = (topk == true[..., None].astype(topk.dtype)).any(-1)
+        hit = hit.reshape(hit.shape[0], -1).mean(axis=-1,
+                                                 dtype=jnp.float32)
+        return jnp.stack([(hit * m).sum(), m.sum()])
 
     def result(self, stats):
         return stats[0] / jnp.maximum(stats[1], 1.0)
 
 
-class MeanAbsoluteError(Metric):
+class _ElementwiseError(Metric):
+    def _err(self, y_pred, y_true):
+        raise NotImplementedError
+
+    def update(self, y_pred, y_true, mask=None):
+        m = _ones_mask(y_pred, mask)
+        if y_true.shape != y_pred.shape and y_true.size == y_pred.size:
+            # [B] labels vs [B, 1] outputs: align rather than broadcast to
+            # a [B, B] cross matrix
+            y_true = y_true.reshape(y_pred.shape)
+        per_elem = self._err(y_pred, y_true).reshape(y_pred.shape[0], -1)
+        per_row = per_elem.sum(axis=-1)
+        elems_per_row = per_elem.shape[-1]
+        return jnp.stack([(per_row * m).sum().astype(jnp.float32),
+                          m.sum() * elems_per_row])
+
+
+class MeanAbsoluteError(_ElementwiseError):
     name = "mae"
 
-    def update(self, y_pred, y_true):
-        err = jnp.abs(y_pred - y_true).sum()
-        return jnp.stack([err.astype(jnp.float32),
-                          jnp.asarray(y_pred.size, jnp.float32)])
+    def _err(self, y_pred, y_true):
+        return jnp.abs(y_pred - y_true)
 
     def result(self, stats):
         return stats[0] / jnp.maximum(stats[1], 1.0)
 
 
-class MeanSquaredError(Metric):
+class MeanSquaredError(_ElementwiseError):
     name = "mse"
 
-    def update(self, y_pred, y_true):
-        err = jnp.square(y_pred - y_true).sum()
-        return jnp.stack([err.astype(jnp.float32),
-                          jnp.asarray(y_pred.size, jnp.float32)])
+    def _err(self, y_pred, y_true):
+        return jnp.square(y_pred - y_true)
 
     def result(self, stats):
         return stats[0] / jnp.maximum(stats[1], 1.0)
@@ -102,13 +129,17 @@ class BinaryAUC(Metric):
     def __init__(self, num_bins: int = 200):
         self.num_bins = num_bins
 
-    def update(self, y_pred, y_true):
+    def update(self, y_pred, y_true, mask=None):
+        m = _ones_mask(y_pred, mask)
+        # per-example weight broadcast over any extra output dims
+        w = jnp.broadcast_to(m.reshape(-1, *([1] * (y_pred.ndim - 1))),
+                             y_pred.shape).reshape(-1)
         p = jax.nn.sigmoid(y_pred.reshape(-1))  # y_pred is logits, like losses
         p = jnp.clip(p, 0.0, 1.0 - 1e-7)
         t = y_true.reshape(-1).astype(jnp.float32)
         bins = jnp.floor(p * self.num_bins).astype(jnp.int32)
-        pos = jnp.zeros(self.num_bins).at[bins].add(t)
-        neg = jnp.zeros(self.num_bins).at[bins].add(1.0 - t)
+        pos = jnp.zeros(self.num_bins).at[bins].add(t * w)
+        neg = jnp.zeros(self.num_bins).at[bins].add((1.0 - t) * w)
         return jnp.stack([pos, neg])
 
     def result(self, stats):

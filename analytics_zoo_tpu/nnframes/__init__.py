@@ -1,0 +1,25 @@
+"""NNFrames: DataFrame-native train/transform (Spark ML Pipeline analog).
+
+Reference (SURVEY.md §2.3 "NNFrames"): ``NNEstimator.fit(df)`` trained a
+BigDL model straight from DataFrame columns via ``Preprocessing``
+converters and returned an ``NNModel`` Spark-ML transformer;
+``NNClassifier``/``NNClassifierModel`` specialized to class labels;
+``NNImageReader`` loaded images into a DataFrame (Scala
+``pipeline/nnframes/*.scala``, Py ``pyzoo/zoo/pipeline/nnframes/
+nn_classifier.py``, ``nn_image_reader.py``).
+
+TPU-native redesign: the "DataFrame" is pandas — either one frame or an
+``XShards`` of per-host frames — because with Spark gone, pandas is the
+frame runtime users actually hold.  The estimator/transformer contract is
+kept: ``fit`` returns an ``NNModel`` whose ``transform(df)`` appends a
+prediction column, so sklearn/Spark-ML-style pipelines port 1:1.  The
+train path is the unified orca Estimator underneath — same jit step, same
+mesh sharding.
+"""
+
+from .nn_classifier import (NNEstimator, NNModel, NNClassifier,
+                            NNClassifierModel)
+from .nn_image_reader import NNImageReader
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

@@ -1,6 +1,7 @@
 """Orca learn: the unified Estimator layer (reference L6, SURVEY.md §2.4)."""
 
 from .estimator import Estimator, ZooEstimator
+from .gan import GANEstimator
 from .trigger import EveryEpoch, SeveralIteration, Trigger
 from . import optimizers
 

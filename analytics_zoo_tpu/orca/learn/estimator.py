@@ -76,7 +76,11 @@ class ZooEstimator:
                  app_name: str = "train",
                  model_dir: Optional[str] = None,
                  sharding: Any = "dp",
-                 aux_loss_weight: float = 0.01):
+                 aux_loss_weight: float = 0.01,
+                 profile_dir: Optional[str] = None,
+                 profile_steps: Any = (10, 20),
+                 preemption_checkpoint: bool = False,
+                 preemption_sync_every: int = 10):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -99,6 +103,21 @@ class ZooEstimator:
         self._pred_step = None
         self._epoch = 0
         self._py_step = 0  # host-side mirror of ts["step"] (no device sync)
+        # jax.profiler integration (SURVEY.md §5.1 tracing parity): capture
+        # a device trace for steps [start, end) into profile_dir, viewable
+        # in TensorBoard/XProf/Perfetto
+        self.profile_dir = profile_dir
+        self.profile_steps = tuple(profile_steps)
+        self._profiling = False
+        # preemption-safe training (core/failover.py): SIGTERM → consensus
+        # checkpoint to model_dir → raise Preempted
+        self._preempt = None
+        if preemption_checkpoint:
+            if model_dir is None:
+                raise ValueError(
+                    "preemption_checkpoint=True needs model_dir")
+            from analytics_zoo_tpu.core.failover import PreemptionGuard
+            self._preempt = PreemptionGuard(preemption_sync_every).install()
 
     # -- state ----------------------------------------------------------------
 
@@ -163,9 +182,17 @@ class ZooEstimator:
             out, _ = model.apply({"params": ts["params"],
                                   "state": ts["state"]}, batch["x"],
                                  training=False)
-            stats = [loss_fn(out, batch["y"])]
+            mask = batch.get("mask")
+            if mask is None:
+                mask = jnp.ones((_first_leaf(out).shape[0],), jnp.float32)
+            # per-example loss (vmap over the mean-reducing loss) so padded
+            # rows can be weighted out exactly; reductions over the global
+            # sharded batch compile to psums — sums are GLOBAL, not
+            # host-local, in multihost runs
+            per_ex = _per_example_loss(loss_fn, out, batch["y"])
+            stats = [jnp.stack([(per_ex * mask).sum(), mask.sum()])]
             for m in metrics:
-                stats.append(m.update(out, batch["y"]))
+                stats.append(m.update(out, batch["y"], mask))
             return stats
 
         def pred_step(ts, x):
@@ -192,56 +219,113 @@ class ZooEstimator:
             checkpoint_trigger: Union[Trigger, str, None] = None,
             feature_cols: Optional[Sequence[str]] = None,
             label_cols: Optional[Sequence[str]] = None,
+            auto_resume: bool = False,
             verbose: bool = True) -> Dict[str, List[float]]:
         """Train; returns history {"loss": [...], "val_<metric>": [...]}.
 
         ``data``: DataFeed, XShards, (x, y) tuple, or {"x","y"} dict.
         ``batch_size`` is global (split across the mesh's batch axes).
+        ``auto_resume``: restore from ``model_dir`` if a checkpoint exists
+        (the restart half of preemption-safe training).
         """
         mesh = get_mesh()
+        if (auto_resume and self._ts is None and self.model_dir
+                and ckpt_io.exists(self.model_dir)):
+            self.load(self.model_dir)
+            logger.info("auto-resumed from %s at step %d", self.model_dir,
+                        self._py_step)
         data = _maybe_select_cols(data, feature_cols, label_cols)
         feed = as_feed(data, batch_size, seed=self.seed)
         trigger = Trigger.get(checkpoint_trigger)
         history: Dict[str, List[float]] = {"loss": []}
 
-        first = True
-        for _ in range(epochs):
-            t0 = time.time()
-            losses = []
-            for batch in feed.epoch(mesh, self._epoch):
-                if first:
-                    self._ensure_initialized(batch["x"])
-                    first = False
-                self._ts, loss_val = self._train_step(self._ts, batch)
-                losses.append(loss_val)
-                # track the step in Python: reading self._ts["step"] would
-                # force a device sync on every iteration
-                self._py_step += 1
+        if self._preempt is not None:
+            self._preempt.active = True
+        try:
+            first = True
+            for _ in range(epochs):
+                t0 = time.time()
+                losses = []
+                for batch in feed.epoch(mesh, self._epoch):
+                    if "mask" in batch:
+                        # a padded final batch from a stream feed: training
+                        # on it would weight the duplicated pad rows fully
+                        # (and retrace train_step on the extra key) — skip
+                        # it, the drop_remainder semantics every training
+                        # feed defaults to.  evaluate() still consumes
+                        # these batches exactly.
+                        continue
+                    if first:
+                        self._ensure_initialized(batch["x"])
+                        first = False
+                    self._maybe_profile()
+                    self._ts, loss_val = self._train_step(self._ts, batch)
+                    losses.append(loss_val)
+                    # track the step in Python: reading self._ts["step"]
+                    # would force a device sync on every iteration
+                    self._py_step += 1
+                    if (self._preempt is not None
+                            and self._preempt.should_checkpoint(
+                                self._py_step)):
+                        self._stop_profile()
+                        path = self.save(self.model_dir)
+                        from analytics_zoo_tpu.core.failover import Preempted
+                        raise Preempted(self._py_step, path)
+                    if trigger and self.model_dir and trigger.fires(
+                            step=self._py_step, epoch_end=False):
+                        self.save(self.model_dir)
+                if not losses:
+                    raise ValueError(
+                        "fit got no full batches (dataset smaller than one "
+                        "batch after dropping the padded tail); reduce "
+                        "batch_size")
+                self._epoch += 1
+                # one host sync per epoch, not per step: losses were left
+                # on device
+                epoch_loss = float(jnp.stack(losses).mean())
+                history["loss"].append(epoch_loss)
+                dt = time.time() - t0
+                n = len(losses) * feed.global_batch
+                if self._writer:
+                    self._writer.add_scalar("loss", epoch_loss, self._epoch)
+                    self._writer.add_scalar("throughput", n / dt,
+                                            self._epoch)
+                if verbose:
+                    logger.info("epoch %d: loss=%.4f (%.1f examples/s)",
+                                self._epoch, epoch_loss, n / dt)
+                if validation_data is not None:
+                    val = self.evaluate(validation_data, batch_size)
+                    for k, v in val.items():
+                        history.setdefault(f"val_{k}", []).append(v)
+                        if self._writer:
+                            self._writer.add_scalar(f"val_{k}", v,
+                                                    self._epoch)
                 if trigger and self.model_dir and trigger.fires(
-                        step=self._py_step, epoch_end=False):
+                        step=self._py_step, epoch_end=True):
                     self.save(self.model_dir)
-            self._epoch += 1
-            # one host sync per epoch, not per step: losses were left on device
-            epoch_loss = float(jnp.stack(losses).mean())
-            history["loss"].append(epoch_loss)
-            dt = time.time() - t0
-            n = len(losses) * feed.global_batch
-            if self._writer:
-                self._writer.add_scalar("loss", epoch_loss, self._epoch)
-                self._writer.add_scalar("throughput", n / dt, self._epoch)
-            if verbose:
-                logger.info("epoch %d: loss=%.4f (%.1f examples/s)",
-                            self._epoch, epoch_loss, n / dt)
-            if validation_data is not None:
-                val = self.evaluate(validation_data, batch_size)
-                for k, v in val.items():
-                    history.setdefault(f"val_{k}", []).append(v)
-                    if self._writer:
-                        self._writer.add_scalar(f"val_{k}", v, self._epoch)
-            if trigger and self.model_dir and trigger.fires(
-                    step=self._py_step, epoch_end=True):
-                self.save(self.model_dir)
+            self._stop_profile()  # short runs: close the trace at fit end
+        finally:
+            if self._preempt is not None:
+                self._preempt.active = False
         return history
+
+    def _maybe_profile(self) -> None:
+        if self.profile_dir is None:
+            return
+        start, end = self.profile_steps
+        if not self._profiling and start <= self._py_step < end:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and self._py_step >= end:
+            self._stop_profile()
+
+    def _stop_profile(self) -> None:
+        if self._profiling:
+            # block so async dispatches land inside the trace
+            jax.block_until_ready(self._ts)
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("wrote jax profiler trace to %s", self.profile_dir)
 
     # -- evaluation -----------------------------------------------------------
 
@@ -249,43 +333,49 @@ class ZooEstimator:
                  feature_cols: Optional[Sequence[str]] = None,
                  label_cols: Optional[Sequence[str]] = None
                  ) -> Dict[str, float]:
+        """Exact metrics over every row: the final partial batch is padded
+        to the static batch shape and weighted out by a mask inside the jit
+        step.  In multihost runs the batch (and mask) are global arrays, so
+        the summed statistics are global — every process returns identical
+        metrics."""
         mesh = get_mesh()
         data = _maybe_select_cols(data, feature_cols, label_cols)
-        feed = as_feed(data, batch_size, shuffle=False, seed=self.seed)
+        feed = as_feed(data, batch_size, shuffle=False, seed=self.seed,
+                       drop_remainder=False)
         totals: Optional[List[Any]] = None
-        n_batches = 0
-        if feed.steps_per_epoch() > 0:
-            for batch in feed.epoch(mesh, 0):
-                self._ensure_initialized(batch["x"])
-                stats = self._eval_step(self._ts, batch)
-                if totals is None:
-                    totals = list(stats)
-                else:
-                    totals = [a + b for a, b in zip(totals, stats)]
-                n_batches += 1
-        # the tail rows drop_remainder skipped: one extra (replicated) step so
-        # metrics cover the full dataset exactly.  (Multi-host note: assumes
-        # per-host evaluate over host-local data; stats are host-local sums.)
-        rem = feed.remainder()
-        full_rows = n_batches * feed.global_batch
-        rem_rows = 0
-        if rem is not None:
-            rem_batch = {k: jnp.asarray(v) for k, v in rem.items()}
-            self._ensure_initialized(rem_batch["x"])
-            rem_rows = int(rem_batch["x"].shape[0])
-            stats = self._eval_step(self._ts, rem_batch)
-            # loss entries are per-batch means: convert both to example-sums
-            if totals is None:
-                totals = [stats[0] * rem_rows] + list(stats[1:])
+
+        def accumulate(totals, batch, step):
+            self._ensure_initialized(batch["x"])
+            if "mask" not in batch:  # feeds may pre-attach masks
+                batch = dict(batch)
+                batch["mask"] = shard_batch(feed.step_mask(step), mesh)
+            stats = self._eval_step(self._ts, batch)
+            return (list(stats) if totals is None
+                    else [a + b for a, b in zip(totals, stats)])
+
+        # shuffled feeds are fine: sums are permutation-invariant and
+        # step_mask zero-weights the padded tail positions either way
+        for step, batch in enumerate(feed.epoch(mesh, 0)):
+            totals = accumulate(totals, batch, step)
+        if feed.drop_remainder:
+            if getattr(feed, "shuffle", False):
+                # the dropped rows are permutation-dependent; the
+                # (unshuffled) remainder would double-count others
+                logger.warning(
+                    "evaluate on a shuffled drop_remainder feed: metrics "
+                    "exclude the rows the shuffle dropped this epoch; use "
+                    "shuffle=False or drop_remainder=False for exact "
+                    "coverage")
             else:
-                totals = ([totals[0] * feed.global_batch +
-                           stats[0] * rem_rows] +
-                          [a + b for a, b in zip(totals[1:], stats[1:])])
-        elif totals is not None:
-            totals = [totals[0] * feed.global_batch] + totals[1:]
+                # user-constructed training feed: cover the dropped tail
+                # with a padded + masked extra batch of the same shape
+                rem = feed.remainder()
+                if rem is not None:
+                    totals = accumulate(totals,
+                                        _pad_remainder(rem, feed, mesh), -1)
         if totals is None:
             raise ValueError("evaluate got no batches")
-        out = {"loss": float(totals[0]) / (full_rows + rem_rows)}
+        out = {"loss": float(totals[0][0] / jnp.maximum(totals[0][1], 1.0))}
         for m, stat in zip(self.metrics, totals[1:]):
             out[m.name] = float(m.result(stat))
         return out
@@ -310,13 +400,14 @@ class ZooEstimator:
         outs: List[np.ndarray] = []
         for batch in feed.epoch(mesh, 0):
             self._ensure_initialized(batch["x"])
-            outs.append(np.asarray(self._pred_step(self._ts, batch["x"])))
+            outs.append(_to_local_rows(self._pred_step(self._ts,
+                                                       batch["x"])))
         if getattr(feed, "drop_remainder", False):
             rem = feed.remainder()
             if rem is not None:  # tail rows the epoch skipped (replicated)
                 x = jax.tree_util.tree_map(jnp.asarray, rem["x"])
                 self._ensure_initialized(x)
-                outs.append(np.asarray(self._pred_step(self._ts, x)))
+                outs.append(_to_local_rows(self._pred_step(self._ts, x)))
         return np.concatenate(outs, axis=0)[: feed.num_rows]
 
     # -- persistence ----------------------------------------------------------
@@ -387,6 +478,50 @@ class ZooEstimator:
 
     def load_orca_checkpoint(self, path: str) -> None:  # reference-parity name
         self.load(path)
+
+
+def _first_leaf(tree: Any) -> jax.Array:
+    return jax.tree_util.tree_leaves(tree)[0]
+
+
+def _pad_remainder(rem: Dict[str, Any], feed: Any, mesh) -> Dict[str, Any]:
+    """Remainder rows → a full static-shape batch with a 0-weighted pad."""
+    r = len(_first_leaf(rem))
+    lb = feed._local_batch
+
+    def pad(a):
+        a = np.asarray(a)
+        return np.concatenate([a, np.repeat(a[-1:], lb - r, axis=0)], axis=0)
+
+    batch = {k: jax.tree_util.tree_map(pad, v) for k, v in rem.items()}
+    mask = np.zeros((lb,), np.float32)
+    mask[:r] = 1.0
+    batch["mask"] = mask
+    return shard_batch(batch, mesh)
+
+
+def _per_example_loss(loss_fn: Callable, out: Any, y: Any) -> jax.Array:
+    """[batch] losses from a mean-reducing loss: vmap each example through
+    the loss with a singleton batch dim."""
+    def one(o, y1):
+        return loss_fn(jax.tree_util.tree_map(lambda a: a[None], o),
+                       jax.tree_util.tree_map(lambda a: a[None], y1))
+
+    return jax.vmap(one)(out, y)
+
+
+def _to_local_rows(out: jax.Array) -> np.ndarray:
+    """Device output → this process's rows as numpy.  Single-process: the
+    whole batch.  Multihost: the global batch is host-rows concatenated in
+    process order (shard_batch's contract), so slice this process's range
+    after an allgather — np.asarray on a cross-host array would throw."""
+    if jax.process_count() == 1:
+        return np.asarray(out)
+    from jax.experimental import multihost_utils
+    full = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+    rows = full.shape[0] // jax.process_count()
+    return full[jax.process_index() * rows:
+                (jax.process_index() + 1) * rows]
 
 
 def _collect_aux_losses(state: Any) -> jax.Array:

@@ -1,0 +1,215 @@
+"""GANEstimator: alternating generator/discriminator training.
+
+Reference (SURVEY.md §2.3 TFPark): ``pyzoo/zoo/tfpark/gan/gan_estimator.py``
+wrapped tf.contrib.gan — generator_fn/discriminator_fn/losses, alternating
+``d_steps``/``g_steps`` optimizers under TFOptimizer on Spark workers.
+
+TPU-native: BOTH sub-steps are jit-compiled programs over the mesh; the
+alternation schedule is host-side Python (tiny, static).  The generator
+and discriminator each own an optax state; batches arrive sharded on the
+``data`` axis so both adversarial all-reduces ride ICI like any other
+gradient.  Loss functions follow the tf.gan contract:
+``generator_loss(fake_logits)``, ``discriminator_loss(real_logits,
+fake_logits)`` — defaults are the non-saturating GAN losses.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.core import get_mesh
+from analytics_zoo_tpu.data import as_feed
+from analytics_zoo_tpu.nn.module import Module
+from . import optimizers as opt_lib
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+def non_saturating_generator_loss(fake_logits: jax.Array) -> jax.Array:
+    return jnp.mean(jax.nn.softplus(-fake_logits))
+
+
+def non_saturating_discriminator_loss(real_logits: jax.Array,
+                                      fake_logits: jax.Array) -> jax.Array:
+    return (jnp.mean(jax.nn.softplus(-real_logits))
+            + jnp.mean(jax.nn.softplus(fake_logits)))
+
+
+class GANEstimator:
+    def __init__(self, generator: Module, discriminator: Module,
+                 generator_loss: Callable = non_saturating_generator_loss,
+                 discriminator_loss: Callable =
+                 non_saturating_discriminator_loss,
+                 generator_optimizer: Any = "adam",
+                 discriminator_optimizer: Any = "adam",
+                 generator_lr: float = 1e-4,
+                 discriminator_lr: float = 1e-4,
+                 noise_dim: int = 64,
+                 d_steps: int = 1, g_steps: int = 1, seed: int = 0):
+        self.generator = generator
+        self.discriminator = discriminator
+        self.g_loss_fn = generator_loss
+        self.d_loss_fn = discriminator_loss
+        self.g_tx = opt_lib.get(generator_optimizer, generator_lr, None)
+        self.d_tx = opt_lib.get(discriminator_optimizer, discriminator_lr,
+                                None)
+        self.noise_dim = noise_dim
+        self.d_steps = d_steps
+        self.g_steps = g_steps
+        self.seed = seed
+        self._ts: Optional[Dict[str, Any]] = None
+        self._d_step = None
+        self._g_step = None
+
+    # -- state ----------------------------------------------------------------
+
+    def _ensure_initialized(self, example_x: jax.Array) -> None:
+        if self._ts is not None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = get_mesh()
+        rng = jax.random.PRNGKey(self.seed)
+        rg, rd, rs = jax.random.split(rng, 3)
+        noise = jnp.zeros((int(example_x.shape[0]), self.noise_dim),
+                          jnp.float32)
+        g_vars = self.generator.init(rg, noise, training=True)
+        fake = self.generator.apply(g_vars, noise, training=False)[0]
+        d_vars = self.discriminator.init(rd, fake, training=True)
+        repl = NamedSharding(mesh, P())
+        self._ts = jax.device_put({
+            "g_params": g_vars["params"], "g_state": g_vars["state"],
+            "d_params": d_vars["params"], "d_state": d_vars["state"],
+            "g_opt": self.g_tx.init(g_vars["params"]),
+            "d_opt": self.d_tx.init(d_vars["params"]),
+            "rng": rs, "step": jnp.zeros((), jnp.int32),
+        }, repl)
+        self._build_steps()
+
+    def _build_steps(self) -> None:
+        gen, disc = self.generator, self.discriminator
+        g_loss_fn, d_loss_fn = self.g_loss_fn, self.d_loss_fn
+        g_tx, d_tx = self.g_tx, self.d_tx
+        noise_dim = self.noise_dim
+
+        def sample_noise(ts, n):
+            rng = jax.random.fold_in(ts["rng"], ts["step"])
+            return jax.random.normal(rng, (n, noise_dim), jnp.float32)
+
+        def d_step(ts, real):
+            noise = sample_noise(ts, real.shape[0])
+            fake, _ = gen.apply({"params": ts["g_params"],
+                                 "state": ts["g_state"]}, noise,
+                                training=False)
+
+            def lossf(d_params):
+                real_logits, d_state = disc.apply(
+                    {"params": d_params, "state": ts["d_state"]}, real,
+                    training=True)
+                fake_logits, d_state = disc.apply(
+                    {"params": d_params, "state": d_state}, fake,
+                    training=True)
+                return d_loss_fn(real_logits, fake_logits), d_state
+
+            (loss, d_state), grads = jax.value_and_grad(
+                lossf, has_aux=True)(ts["d_params"])
+            updates, d_opt = d_tx.update(grads, ts["d_opt"],
+                                         ts["d_params"])
+            new = dict(ts)
+            new["d_params"] = optax.apply_updates(ts["d_params"], updates)
+            new["d_state"] = d_state
+            new["d_opt"] = d_opt
+            new["step"] = ts["step"] + 1
+            return new, loss
+
+        def g_step(ts, batch_n):
+            noise = sample_noise(ts, batch_n.shape[0])
+
+            def lossf(g_params):
+                fake, g_state = gen.apply(
+                    {"params": g_params, "state": ts["g_state"]}, noise,
+                    training=True)
+                fake_logits, _ = disc.apply(
+                    {"params": ts["d_params"], "state": ts["d_state"]},
+                    fake, training=False)
+                return g_loss_fn(fake_logits), g_state
+
+            (loss, g_state), grads = jax.value_and_grad(
+                lossf, has_aux=True)(ts["g_params"])
+            updates, g_opt = g_tx.update(grads, ts["g_opt"],
+                                         ts["g_params"])
+            new = dict(ts)
+            new["g_params"] = optax.apply_updates(ts["g_params"], updates)
+            new["g_state"] = g_state
+            new["g_opt"] = g_opt
+            new["step"] = ts["step"] + 1
+            return new, loss
+
+        self._d_step = jax.jit(d_step, donate_argnums=0)
+        self._g_step = jax.jit(g_step, donate_argnums=0)
+
+    # -- API ------------------------------------------------------------------
+
+    def fit(self, data: Any, epochs: int = 1, batch_size: int = 32,
+            verbose: bool = True) -> Dict[str, List[float]]:
+        """``data``: real samples — array, (x,) tuple, dict or feed."""
+        mesh = get_mesh()
+        feed = as_feed(data, batch_size, seed=self.seed)
+        history: Dict[str, List[float]] = {"d_loss": [], "g_loss": []}
+        for epoch in range(epochs):
+            d_losses, g_losses = [], []
+            for batch in feed.epoch(mesh, epoch):
+                real = batch["x"]
+                self._ensure_initialized(real)
+                for _ in range(self.d_steps):
+                    self._ts, dl = self._d_step(self._ts, real)
+                    d_losses.append(dl)
+                for _ in range(self.g_steps):
+                    self._ts, gl = self._g_step(self._ts, real)
+                    g_losses.append(gl)
+            history["d_loss"].append(float(jnp.stack(d_losses).mean()))
+            history["g_loss"].append(float(jnp.stack(g_losses).mean()))
+            if verbose:
+                logger.info("epoch %d: d_loss=%.4f g_loss=%.4f", epoch + 1,
+                            history["d_loss"][-1], history["g_loss"][-1])
+        return history
+
+    def generate(self, n: int, seed: Optional[int] = None) -> np.ndarray:
+        """Sample n outputs from the generator."""
+        if self._ts is None:
+            raise ValueError("fit first")
+        rng = jax.random.PRNGKey(self.seed + 1 if seed is None else seed)
+        noise = jax.random.normal(rng, (n, self.noise_dim), jnp.float32)
+        out, _ = self.generator.apply(
+            {"params": self._ts["g_params"],
+             "state": self._ts["g_state"]}, noise, training=False)
+        return np.asarray(out)
+
+    def save(self, path: str) -> str:
+        from analytics_zoo_tpu.core import checkpoint as ckpt_io
+        if self._ts is None:
+            raise ValueError("nothing to save: fit first")
+        return ckpt_io.save(path, jax.tree_util.tree_map(lambda x: x,
+                                                         self._ts))
+
+    def load(self, path: str, example_x: np.ndarray) -> None:
+        from analytics_zoo_tpu.core import checkpoint as ckpt_io
+        self._ensure_initialized(jnp.asarray(example_x))
+        saved = ckpt_io.restore(path)
+        # checkpoint IO stores optax NamedTuples as plain tuples; pour the
+        # saved leaves back into the live structure (same trick as
+        # Estimator.load)
+        ref_leaves, ref_def = jax.tree_util.tree_flatten(self._ts)
+        saved_leaves = jax.tree_util.tree_leaves(saved)
+        if len(saved_leaves) != len(ref_leaves):
+            raise ValueError("checkpoint does not match this GAN's "
+                             "architecture/optimizers")
+        self._ts = jax.tree_util.tree_unflatten(ref_def, [
+            jax.device_put(jnp.asarray(s), r.sharding)
+            if hasattr(r, "sharding") else s
+            for s, r in zip(saved_leaves, ref_leaves)])

@@ -99,7 +99,20 @@ def main() -> None:
     np.testing.assert_array_equal(
         np.asarray(k_rep.addressable_shards[0].data), k_dense)
 
-    print(f"MULTIHOST_OK {after['loss']:.6f}", flush=True)
+    # iterator feed across processes: strided split + per-batch consensus
+    # (unequal local stream lengths; all-masked filler batches)
+    from analytics_zoo_tpu.data import from_iterator
+
+    def gen(epoch_idx):
+        for i in range(37):  # 19 rows on p0, 18 on p1 via striding
+            yield x_all[i % 64], y_all[i % 64]
+
+    stream_res = est.evaluate(from_iterator(gen, batch_size=16),
+                              batch_size=16)
+    assert np.isfinite(stream_res["loss"]), stream_res
+
+    print(f"MULTIHOST_OK {after['loss']:.6f} {stream_res['loss']:.6f}",
+          flush=True)
 
 
 if __name__ == "__main__":

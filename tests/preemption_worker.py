@@ -1,0 +1,41 @@
+"""Worker for the preemption test: trains "forever" until SIGTERM arrives,
+then exits 143 after the consensus checkpoint (core/failover.py).  On a
+second run with a checkpoint present, auto-resumes and prints the resumed
+step."""
+
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    model_dir = sys.argv[1]
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 100000
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import Preempted, init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(8, activation="relu"), nn.Dense(1)])
+    est = Estimator.from_keras(model, loss="mse", learning_rate=1e-3,
+                               model_dir=model_dir,
+                               preemption_checkpoint=True,
+                               preemption_sync_every=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    y = rng.normal(size=(256, 1)).astype(np.float32)
+    print("TRAINING_STARTED", flush=True)
+    try:
+        est.fit((x, y), epochs=epochs, batch_size=32, auto_resume=True,
+                verbose=False)
+    except Preempted as e:
+        print(f"PREEMPTED step={e.step} path={e.path}", flush=True)
+        sys.exit(143)
+    print(f"FINISHED step={est._py_step}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -153,11 +153,18 @@ def test_batchnorm_model_trains():
     assert preds.shape == (128, 4)
 
 
-def test_evaluate_empty_raises():
+def test_evaluate_dataset_smaller_than_batch():
+    # masked padded batches: a 2-row dataset evaluates exactly even with
+    # batch_size 64 (previously raised "no batches")
     init_orca_context("local")
     est = Estimator.from_keras(mlp(), loss="mse")
-    with pytest.raises(ValueError):
-        est.evaluate((np.ones((2, 4), np.float32), np.ones(2)), batch_size=64)
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 1), np.float32)
+    est.fit((np.ones((8, 4), np.float32), np.zeros((8, 1), np.float32)),
+            epochs=1, batch_size=8, verbose=False)
+    res = est.evaluate((x, y), batch_size=64)
+    pred = est.predict(x, batch_size=64)
+    assert abs(res["loss"] - float(np.square(pred - y).mean())) < 1e-5
 
 
 def test_save_uninitialized_raises(tmp_path):
@@ -186,3 +193,23 @@ def test_evaluate_covers_remainder_rows(rng):
     assert abs(res["mae"] - expect_mae) < 1e-5
     expect_loss = float(np.square(pred - y).mean())
     assert abs(res["loss"] - expect_loss) < 1e-5
+
+
+def test_profiler_trace_written(tmp_path, rng):
+    """jax.profiler integration (SURVEY §5.1): fit with profile_dir writes
+    a trace capture under the directory."""
+    import os
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(1)])
+    prof = str(tmp_path / "prof")
+    est = Estimator.from_keras(model, loss="mse", profile_dir=prof,
+                               profile_steps=(1, 3))
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.zeros((64, 1), np.float32)
+    est.fit((x, y), epochs=1, batch_size=16, verbose=False)
+    assert not est._profiling
+    found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+    assert found, "no profiler trace files written"

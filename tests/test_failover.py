@@ -1,0 +1,93 @@
+"""Preemption-safe training tests (SURVEY.md §5.3 failure recovery).
+
+The real contract — SIGTERM mid-training → checkpoint lands → process
+exits → a fresh process resumes from the step it left — is exercised with
+actual OS signals on a subprocess, the cluster-in-a-box way the reference
+tested failure paths."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "preemption_worker.py")
+
+
+def _spawn(model_dir, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(model_dir), *args], env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    model_dir = tmp_path / "ckpt"
+    # phase 1: train until SIGTERM
+    proc = _spawn(model_dir)
+    # wait for the train loop to actually start before signalling
+    line = ""
+    deadline = time.time() + 180
+    while "TRAINING_STARTED" not in line:
+        assert time.time() < deadline, "worker never started training"
+        line = proc.stdout.readline()
+    time.sleep(1.0)  # let a few steps run
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 143, out[-3000:]
+    m = re.search(r"PREEMPTED step=(\d+)", out)
+    assert m, out[-3000:]
+    preempted_step = int(m.group(1))
+    assert preempted_step > 0
+    assert (model_dir / "treedef.json").exists()
+
+    # phase 2: fresh process auto-resumes past the preempted step
+    proc2 = _spawn(model_dir, "1")
+    out2, _ = proc2.communicate(timeout=180)
+    assert proc2.returncode == 0, out2[-3000:]
+    m2 = re.search(r"FINISHED step=(\d+)", out2)
+    assert m2, out2[-3000:]
+    assert int(m2.group(1)) > preempted_step
+
+
+def test_guard_consensus_single_process():
+    from analytics_zoo_tpu.core import PreemptionGuard
+    g = PreemptionGuard(sync_every=4)
+    g.active = True  # inside fit(): flag-and-continue mode
+    # no signal: never fires
+    assert not g.should_checkpoint(4)
+    g._on_signal(signal.SIGTERM, None)
+    # fires only at sync points
+    assert not g.should_checkpoint(5)
+    assert g.should_checkpoint(8)
+
+
+def test_guard_inactive_signal_chains_to_default():
+    # outside fit() a signal must NOT be swallowed: the guard re-raises
+    # via the previous handler (KeyboardInterrupt for SIGINT)
+    import pytest
+    from analytics_zoo_tpu.core import PreemptionGuard
+    g = PreemptionGuard(sync_every=2).install()
+    try:
+        assert g._installed
+        with pytest.raises(KeyboardInterrupt):
+            g._on_signal(signal.SIGINT, None)
+        assert not g.flagged
+    finally:
+        g.uninstall()
+
+
+def test_preemption_requires_model_dir():
+    import pytest
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.orca.learn import Estimator
+    with pytest.raises(ValueError, match="model_dir"):
+        Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse",
+                             preemption_checkpoint=True)

@@ -1,0 +1,167 @@
+"""Foreign input-pipeline interop tests (SURVEY.md §2.2: orca TF Dataset /
+TFDataset / torch data_creator parity)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import get_mesh, init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context("local")
+    yield
+
+
+def _gen(n, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        x = rng.normal(size=dim).astype(np.float32)
+        yield x, np.float32(x.sum())
+
+
+def test_from_iterator_rebatches_and_masks():
+    from analytics_zoo_tpu.data import from_iterator
+    feed = from_iterator(lambda e: _gen(37), batch_size=8)
+    mesh = get_mesh()
+    batches = list(feed.epoch(mesh, 0))
+    assert feed.num_rows == 37
+    assert len(batches) == 5  # 4 full + 1 padded
+    assert all(b["x"].shape == (8, 4) for b in batches)
+    assert "mask" not in batches[0]
+    last = batches[-1]
+    assert "mask" in last
+    np.testing.assert_array_equal(
+        np.asarray(last["mask"]), [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_from_iterator_drop_remainder():
+    from analytics_zoo_tpu.data import from_iterator
+    feed = from_iterator(lambda e: _gen(37), batch_size=8,
+                         drop_remainder=True)
+    batches = list(feed.epoch(get_mesh(), 0))
+    assert len(batches) == 4
+    assert all("mask" not in b for b in batches)
+
+
+def test_estimator_fit_evaluate_on_iterator_feed():
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.data import from_iterator
+    from analytics_zoo_tpu.orca.learn import Estimator
+    model = nn.Sequential([nn.Dense(1)])
+    est = Estimator.from_keras(model, loss="mse", learning_rate=5e-2,
+                               metrics=["mae"])
+    train = from_iterator(lambda e: _gen(64, seed=e), batch_size=16,
+                          drop_remainder=True)
+    hist = est.fit(train, epochs=3, batch_size=16, verbose=False)
+    assert hist["loss"][-1] < hist["loss"][0]
+    # evaluate over a 37-row stream: padded+masked tail must be exact
+    ev = from_iterator(lambda e: _gen(37, seed=7), batch_size=16)
+    res = est.evaluate(ev, batch_size=16)
+    x = np.stack([s[0] for s in _gen(37, seed=7)])
+    y = np.stack([s[1] for s in _gen(37, seed=7)])
+    pred = est.predict(x, batch_size=16)
+    assert abs(res["loss"] - float(np.square(pred[:, 0] - y).mean())) < 1e-4
+    assert abs(res["mae"] - float(np.abs(pred[:, 0] - y).mean())) < 1e-4
+
+
+def test_evaluate_covers_tail_of_drop_remainder_feed():
+    # user passes a training-style feed (drop_remainder=True): evaluate
+    # must still cover the dropped tail rows (regression: code review)
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.data import DataFeed
+    from analytics_zoo_tpu.orca.learn import Estimator
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(10, 4)).astype(np.float32)
+    y = rng.normal(size=(10, 1)).astype(np.float32)
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse")
+    est.fit((x, y), epochs=1, batch_size=8, verbose=False)
+    feed = DataFeed.from_arrays(x, y, batch_size=8, shuffle=False,
+                                drop_remainder=True)
+    res = est.evaluate(feed, batch_size=8)
+    pred = est.predict(x, batch_size=8)
+    assert abs(res["loss"] - float(np.square(pred - y).mean())) < 1e-5
+
+
+def test_evaluate_shuffled_nondrop_feed_is_exact():
+    # metric sums are permutation-invariant and the padded tail positions
+    # are masked, so a shuffled drop_remainder=False feed evaluates exactly
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.data import DataFeed
+    from analytics_zoo_tpu.orca.learn import Estimator
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(10, 4)).astype(np.float32)
+    y = rng.normal(size=(10, 1)).astype(np.float32)
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse")
+    est.fit((x, y), epochs=1, batch_size=8, verbose=False)
+    feed = DataFeed.from_arrays(x, y, batch_size=8, shuffle=True,
+                                drop_remainder=False)
+    res = est.evaluate(feed, batch_size=8)
+    pred = est.predict(x, batch_size=8)
+    assert abs(res["loss"] - float(np.square(pred - y).mean())) < 1e-5
+
+
+def test_evaluate_empty_iterable_feed_raises():
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.data import from_iterator
+    from analytics_zoo_tpu.orca.learn import Estimator
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse")
+    with pytest.raises(ValueError, match="no batches"):
+        est.evaluate(from_iterator(lambda e: iter([]), 32), batch_size=32)
+
+
+def test_from_torch_dataset_streaming():
+    torch = pytest.importorskip("torch")
+
+    class DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 48
+
+        def __getitem__(self, i):
+            x = torch.full((4,), float(i))
+            return x, torch.tensor(float(i))
+
+    from analytics_zoo_tpu.data import StreamingDataFeed, from_torch_dataset
+    feed = from_torch_dataset(DS(), batch_size=8, shuffle=False,
+                              num_workers=2)
+    assert isinstance(feed, StreamingDataFeed)
+    batches = list(feed.epoch(get_mesh(), 0))
+    assert len(batches) == 6
+    # order-preserving: row i has value i
+    first = np.asarray(batches[0]["x"])
+    np.testing.assert_array_equal(first[:, 0], np.arange(8, dtype=np.float32))
+
+
+def test_from_torch_dataloader_rebatch():
+    torch = pytest.importorskip("torch")
+    xs = torch.arange(20, dtype=torch.float32).reshape(20, 1)
+    ys = torch.arange(20, dtype=torch.float32)
+    loader = torch.utils.data.DataLoader(
+        torch.utils.data.TensorDataset(xs, ys), batch_size=6)
+    from analytics_zoo_tpu.data import from_torch_dataloader
+    feed = from_torch_dataloader(loader, batch_size=8)
+    batches = list(feed.epoch(get_mesh(), 0))
+    assert feed.num_rows == 20
+    assert [b["x"].shape[0] for b in batches] == [8, 8, 8]
+    assert "mask" in batches[-1]
+    got = np.concatenate([np.asarray(b["x"])[:, 0] for b in batches])
+    np.testing.assert_array_equal(got[:20], np.arange(20, dtype=np.float32))
+
+
+def test_from_tf_dataset_gated():
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.data import from_tf_dataset
+    ds = tf.data.Dataset.from_tensor_slices(
+        (np.ones((10, 3), np.float32), np.zeros(10, np.float32)))
+    feed = from_tf_dataset(ds, batch_size=4)
+    batches = list(feed.epoch(get_mesh(), 0))
+    assert feed.num_rows == 10 and len(batches) == 3
+
+
+def test_from_tf_dataset_missing_tf_raises():
+    import sys
+    if "tensorflow" in sys.modules:
+        pytest.skip("tensorflow available; error path not reachable")
+    from analytics_zoo_tpu.data import from_tf_dataset
+    with pytest.raises(ImportError, match="tensorflow"):
+        from_tf_dataset(object(), batch_size=4)

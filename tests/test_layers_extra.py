@@ -1,0 +1,164 @@
+"""Extended Keras-1.2 layer zoo tests (reference pattern: keras layer specs
+zoo/src/test/.../keras/layers/*Spec.scala — shape + forward checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn as nn
+
+
+def _run(layer, x, training=False, seed=0):
+    variables = layer.init(jax.random.PRNGKey(seed), x, training=training)
+    out, _ = layer.apply(variables, x, training=training,
+                         rng=jax.random.PRNGKey(seed + 1))
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("layer,shape,expect", [
+    (nn.Conv3D(4, 3), (2, 5, 6, 7, 3), (2, 5, 6, 7, 4)),
+    (nn.Conv3D(4, 2, strides=2, padding="valid"), (2, 4, 6, 8, 3),
+     (2, 2, 3, 4, 4)),
+    (nn.Conv2DTranspose(5, 3, strides=2), (2, 7, 7, 3), (2, 14, 14, 5)),
+    (nn.DepthwiseConv2D(3, depth_multiplier=2), (2, 8, 8, 3), (2, 8, 8, 6)),
+    (nn.SeparableConv2D(10, 3), (2, 8, 8, 4), (2, 8, 8, 10)),
+    (nn.LocallyConnected1D(6, 3), (2, 10, 4), (2, 8, 6)),
+    (nn.MaxPooling1D(2), (2, 10, 3), (2, 5, 3)),
+    (nn.AveragePooling1D(2), (2, 10, 3), (2, 5, 3)),
+    (nn.MaxPooling3D(2), (2, 4, 6, 8, 3), (2, 2, 3, 4, 3)),
+    (nn.AveragePooling3D(2), (2, 4, 6, 8, 3), (2, 2, 3, 4, 3)),
+    (nn.GlobalAveragePooling3D(), (2, 4, 5, 6, 3), (2, 3)),
+    (nn.GlobalMaxPooling3D(), (2, 4, 5, 6, 3), (2, 3)),
+    (nn.UpSampling1D(3), (2, 4, 5), (2, 12, 5)),
+    (nn.UpSampling2D(2), (2, 3, 4, 5), (2, 6, 8, 5)),
+    (nn.UpSampling3D(2), (2, 2, 3, 4, 5), (2, 4, 6, 8, 5)),
+    (nn.ZeroPadding1D(2), (2, 5, 3), (2, 9, 3)),
+    (nn.ZeroPadding3D(1), (2, 3, 4, 5, 2), (2, 5, 6, 7, 2)),
+    (nn.Cropping1D(1), (2, 6, 3), (2, 4, 3)),
+    (nn.Cropping2D(((1, 2), (0, 1))), (2, 8, 8, 3), (2, 5, 7, 3)),
+    (nn.RepeatVector(4), (2, 7), (2, 4, 7)),
+    (nn.Permute((2, 1)), (2, 3, 5), (2, 5, 3)),
+    (nn.LeakyReLU(0.1), (2, 5), (2, 5)),
+    (nn.ELU(), (2, 5), (2, 5)),
+    (nn.ThresholdedReLU(0.5), (2, 5), (2, 5)),
+    (nn.PReLU(), (2, 5), (2, 5)),
+    (nn.Highway(), (3, 8), (3, 8)),
+    (nn.MaxoutDense(6, nb_feature=3), (4, 10), (4, 6)),
+])
+def test_layer_output_shapes(layer, shape, expect):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    assert _run(layer, x).shape == expect
+
+
+def test_upsampling_values():
+    x = jnp.arange(4, dtype=jnp.float32).reshape(1, 2, 2, 1)
+    out = _run(nn.UpSampling2D(2), x)
+    np.testing.assert_array_equal(out[0, :, :, 0],
+                                  [[0, 0, 1, 1], [0, 0, 1, 1],
+                                   [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_depthwise_matches_grouped_dense_math():
+    # depthwise with multiplier 1 == per-channel independent conv
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(1, 6, 6, 2)), jnp.float32)
+    layer = nn.DepthwiseConv2D(3, use_bias=False, padding="valid")
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    out, _ = layer.apply(variables, x)
+    w = variables["params"]["kernel"]  # [3, 3, 1, 2]
+    for c in range(2):
+        ref = jax.lax.conv_general_dilated(
+            x[..., c:c + 1], w[..., c:c + 1], (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(out[..., c]),
+                                   np.asarray(ref[..., 0]), atol=1e-5)
+
+
+def test_merge_layers():
+    a = jnp.asarray([[1.0, 2.0]])
+    b = jnp.asarray([[3.0, 0.0]])
+    assert np.allclose(_run(nn.Average(), [a, b]), [[2.0, 1.0]])
+    assert np.allclose(_run(nn.Maximum(), [a, b]), [[3.0, 2.0]])
+    assert np.allclose(_run(nn.Minimum(), [a, b]), [[1.0, 0.0]])
+    assert np.allclose(_run(nn.Subtract(), [a, b]), [[-2.0, 2.0]])
+    assert np.allclose(_run(nn.Dot(), [a, b]), [3.0])
+
+
+def test_dot_distinct_axes_batch_dot():
+    # keras batch_dot semantics: contract a axis 2 with b axis 1
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(2, 4, 5)), jnp.float32)
+    out = _run(nn.Dot(axes=(2, 1)), [a, b])
+    assert out.shape == (2, 3, 5)
+    np.testing.assert_allclose(out, np.einsum("bik,bkj->bij", a, b),
+                               rtol=1e-5)
+
+
+def test_dot_batch_axis_rejected():
+    a = jnp.ones((2, 3))
+    with pytest.raises(ValueError, match="batch dim"):
+        _run(nn.Dot(axes=(0, 1)), [a, a])
+
+
+def test_masking_zeroes_masked_steps():
+    x = jnp.asarray([[[1.0, 2.0], [0.0, 0.0], [3.0, 0.0]]])
+    out = _run(nn.Masking(0.0), x)
+    np.testing.assert_array_equal(out[0, 1], [0.0, 0.0])
+    np.testing.assert_array_equal(out[0, 2], [3.0, 0.0])
+
+
+def test_stochastic_layers_train_vs_eval():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 6, 8)), jnp.float32) + 5.0
+    for layer in (nn.SpatialDropout1D(0.5), nn.GaussianNoise(1.0),
+                  nn.GaussianDropout(0.5)):
+        # eval: identity
+        np.testing.assert_array_equal(_run(layer, x, training=False), x)
+        # train: changes values
+        assert not np.allclose(_run(layer, x, training=True), x)
+
+
+def test_spatial_dropout_drops_whole_channels():
+    x = jnp.ones((2, 16, 8), jnp.float32)
+    out = _run(nn.SpatialDropout1D(0.5), x, training=True)
+    # each (batch, channel) is either all-zero or all-scaled across time
+    for bi in range(2):
+        for c in range(8):
+            col = out[bi, :, c]
+            assert np.all(col == 0.0) or np.all(col == col[0])
+
+
+def test_highway_carry_behavior():
+    # with gate bias -1 the layer starts mostly-carry: output close to input
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    out = _run(nn.Highway(), x)
+    assert np.abs(out - np.asarray(x)).mean() < 1.0
+
+
+def test_prelu_gradient_flows():
+    x = jnp.asarray([[-2.0, 3.0]])
+    layer = nn.PReLU()
+    variables = layer.init(jax.random.PRNGKey(0), x)
+
+    def loss(params):
+        out, _ = layer.apply({"params": params}, x)
+        return jnp.sum(out)
+
+    g = jax.grad(loss)(variables["params"])
+    assert np.asarray(g["alpha"])[0] != 0.0  # negative input drives alpha
+
+
+def test_locally_connected_positions_independent():
+    # different positions use different kernels: permuting time changes out
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(1, 6, 3)), jnp.float32)
+    layer = nn.LocallyConnected1D(2, 3, use_bias=False)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    out1, _ = layer.apply(variables, x)
+    out2, _ = layer.apply(variables, x[:, ::-1])
+    assert not np.allclose(np.asarray(out1)[:, ::-1], np.asarray(out2),
+                           atol=1e-4)
