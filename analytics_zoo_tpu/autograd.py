@@ -1,0 +1,89 @@
+"""autograd parity namespace: custom ops/losses as plain expressions.
+
+Reference (SURVEY.md §2.3): ``pyzoo/zoo/pipeline/api/autograd.py`` +
+Scala ``pipeline/api/autograd/*.scala`` — a define-by-expression
+``Variable`` system (~3k LoC) existed because BigDL graphs could not
+otherwise express custom math: ``Variable`` arithmetic built graph nodes,
+``CustomLoss`` compiled a variable expression into a loss layer, ``Lambda``
+wrapped expressions as layers.
+
+TPU-native: JAX *is* the autograd, so a "Variable expression" is just a
+traced jnp computation.  This module keeps the reference's call surface —
+the function names users wrote (``A.mean(A.square(y_true - y_pred))``)
+and ``CustomLoss`` — mapping 1:1 onto jnp, so reference custom losses port
+by changing only the import.  ``Lambda`` lives in nn.layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+# -- the reference's AutoGrad function surface (autograd.py top-level) --------
+
+abs = jnp.abs                # noqa: A001 — reference name
+sum = jnp.sum                # noqa: A001
+mean = jnp.mean
+square = jnp.square
+sqrt = jnp.sqrt
+exp = jnp.exp
+log = jnp.log
+maximum = jnp.maximum
+minimum = jnp.minimum
+clip = jnp.clip
+pow = jnp.power              # noqa: A001
+neg = jnp.negative
+stack = jnp.stack
+expand_dims = jnp.expand_dims
+squeeze = jnp.squeeze
+softsign = jax.nn.soft_sign
+softplus = jax.nn.softplus
+epsilon = 1e-7
+
+
+def mm(x: jax.Array, y: jax.Array, axes=None) -> jax.Array:
+    """Reference AutoGrad.mm: matrix multiply (axes kept for parity)."""
+    if axes is not None:
+        return jnp.tensordot(x, y, axes=axes)
+    return x @ y
+
+
+def batch_dot(x: jax.Array, y: jax.Array, axes=(2, 1),
+              normalize: bool = False) -> jax.Array:
+    """Reference AutoGrad.batchDot → the nn.Dot contraction."""
+    from analytics_zoo_tpu.nn import Dot
+    layer = Dot(axes=axes, normalize=normalize)
+    out, _ = layer.apply({"params": {}}, [x, y])
+    return out
+
+
+def l2_normalize(x: jax.Array, axis: int = -1) -> jax.Array:
+    return x / (jnp.linalg.norm(x, axis=axis, keepdims=True) + epsilon)
+
+
+def contiguous(x: jax.Array) -> jax.Array:
+    return x  # layout is XLA's concern
+
+
+class CustomLoss:
+    """Loss from an expression (reference: ``CustomLoss(loss_func,
+    y_pred_shape)`` — compiled the Variable graph into a loss layer).
+
+    ``loss_func(y_true, y_pred) -> scalar-or-per-example`` using any jnp /
+    autograd functions.  Instances are callable with the framework's
+    ``(y_pred, y_true)`` convention, so they drop straight into
+    ``Estimator.from_keras(loss=CustomLoss(fn))``."""
+
+    def __init__(self, loss_func: Callable, y_pred_shape: Any = None):
+        self.loss_func = loss_func  # reference arg order: (y_true, y_pred)
+        self.y_pred_shape = y_pred_shape  # parity only; shapes are traced
+
+    def __call__(self, y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+        out = self.loss_func(y_true, y_pred)
+        return jnp.mean(out)
+
+    # reference spelling: loss.forward(y_true, y_pred)
+    def forward(self, y_true: jax.Array, y_pred: jax.Array) -> jax.Array:
+        return float(self(y_pred, y_true))

@@ -208,5 +208,13 @@ class TSDataset:
             raise ValueError("call roll() first")
         return self._x, self._y
 
+    def to_feed(self, batch_size: int = 32, shuffle: bool = True,
+                **kw: Any):
+        """Rolled windows → a device DataFeed (reference:
+        TSDataset.to_torch_data_loader — the train-loader bridge)."""
+        from analytics_zoo_tpu.data import DataFeed
+        x, y = self.to_numpy()
+        return DataFeed.from_arrays(x, y, batch_size, shuffle=shuffle, **kw)
+
     def to_pandas(self) -> pd.DataFrame:
         return self.df.copy()

@@ -86,7 +86,8 @@ def _key_to_index(key: str) -> tuple:
                  for a, b in (p.split(":") for p in key.split(",")))
 
 
-def save(path: str, tree: Any, step: Optional[int] = None) -> str:
+def save(path: str, tree: Any, step: Optional[int] = None,
+         extra: Optional[dict] = None) -> str:
     """Write ``tree`` under directory ``path`` (created if needed).
 
     Multi-host: every process must call this.  Each process writes ONLY the
@@ -171,6 +172,7 @@ def save(path: str, tree: Any, step: Optional[int] = None) -> str:
             "step": step,
             "gen": gen,
             "raw_dtypes": raw_dtypes,
+            "extra": extra or {},  # small json-able caller metadata
         }
         fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
         with os.fdopen(fd, "w") as f:
@@ -358,6 +360,15 @@ def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
             leaves.append(_decode_scalar(enc))
     treedef = _treedef_from_json(meta["treedef"])
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def load_extra(path: str) -> dict:
+    """The caller metadata dict passed to ``save(extra=...)``."""
+    try:
+        with open(os.path.join(path, _META)) as f:
+            return json.load(f).get("extra") or {}
+    except (OSError, json.JSONDecodeError):
+        return {}
 
 
 def latest_step(path: str) -> Optional[int]:

@@ -121,11 +121,15 @@ class IterableDataFeed(FeedBase):
                 # a process that ran dry emits all-masked filler batches
                 # until the slowest stream finishes
                 from jax.experimental import multihost_utils
-                reals = multihost_utils.process_allgather(
-                    np.asarray([n_real], np.int32))
+                starved = n_real == 0 and last_row is None
+                stats = multihost_utils.process_allgather(
+                    np.asarray([n_real, int(starved)], np.int32))
+                reals = stats[..., 0]
                 if int(np.max(reals)) == 0:
                     break
-                if n_real == 0 and last_row is None:
+                if int(np.max(stats[..., 1])):
+                    # raise on EVERY process (a local-only raise would
+                    # leave the peers hanging in the next collective)
                     raise ValueError(
                         "a process received zero samples while others have "
                         "data; give every host samples (or use "

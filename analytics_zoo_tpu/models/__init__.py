@@ -15,15 +15,16 @@ from .textmatching import KNRM
 from .anomalydetection import AnomalyDetector, unroll
 from .seq2seq import Seq2seq, RNNEncoder, RNNDecoder
 from .image import ImageClassifier, ResNet
-from .objectdetection import ObjectDetector, SSDLite
-from .bert import BERT, BERTClassifier, BERTSQuAD
+from .objectdetection import ObjectDetector, SSDLite, Visualizer
+from .bert import BERT, BERTClassifier, BERTNER, BERTSQuAD
+from .graphnet import GraphNet
 from .net import ForeignNet, Net
 
 __all__ = [
-    "Net", "ForeignNet",
+    "Net", "ForeignNet", "GraphNet",
     "ZooModel", "NeuralCF", "WideAndDeep", "SessionRecommender",
     "UserItemFeature", "UserItemPrediction", "TextClassifier", "KNRM",
     "AnomalyDetector", "unroll", "Seq2seq", "RNNEncoder", "RNNDecoder",
-    "ImageClassifier", "ResNet", "ObjectDetector", "SSDLite",
-    "BERT", "BERTClassifier", "BERTSQuAD",
+    "ImageClassifier", "ResNet", "ObjectDetector", "SSDLite", "Visualizer",
+    "BERT", "BERTClassifier", "BERTNER", "BERTSQuAD",
 ]

@@ -104,6 +104,23 @@ class BERTSQuAD(ZooModel):
         return scope.child(nn.Dense(2), h, name="span_head")
 
 
+class BERTNER(ZooModel):
+    """Token-classification head: per-token entity logits (reference:
+    tfpark text/estimator BERTNER — the named-entity-recognition
+    estimator).  Output [B, T, num_entities]; train with sparse
+    cross-entropy over tokens."""
+
+    def __init__(self, entity_num: int, **bert_kwargs: Any):
+        super().__init__()
+        self._config = dict(entity_num=entity_num, **bert_kwargs)
+        self.entity_num = entity_num
+        self.bert = BERT(**bert_kwargs)
+
+    def forward(self, scope: Scope, ids: jax.Array) -> jax.Array:
+        h = scope.child(self.bert, ids, name="bert")
+        return scope.child(nn.Dense(self.entity_num), h, name="ner_head")
+
+
 def squad_span_loss(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
     """y_pred [B, T, 2]; y_true int [B, 2] = (start_idx, end_idx)."""
     start_logits = y_pred[..., 0]

@@ -1,0 +1,80 @@
+"""GraphNet surgery: intermediate outputs + layer freezing for transfer
+learning.
+
+Reference (SURVEY.md §2.3 "Net loaders"): ``GraphNet`` in
+zoo/.../pipeline/api/net/{Net,GraphNet}.scala — ``newGraph(output)`` cut a
+loaded graph at a named layer (feature extraction) and
+``freezeUpTo(names)`` stopped gradients flowing into the backbone, the
+reference's canonical fine-tuning recipe.
+
+TPU-native: models are pure functions, so "surgery" is functional —
+``Module.apply_with_taps`` records every submodule output by scope path,
+``GraphNet`` selects one as the new output, and freezing is an optimizer
+mask (``Estimator.from_keras(..., frozen=[...])`` → optax.multi_transform
+with set_to_zero on the frozen label), which XLA folds into the update
+step.  No graph mutation, no weight copying.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+
+from analytics_zoo_tpu.nn.module import Module, Params, Scope
+
+
+class GraphNet(Module):
+    """Wrap ``base`` and output the activations at ``outputs`` (scope paths
+    relative to the base, e.g. ``["block3", "block3/mha"]``).
+
+    ``GraphNet(resnet, ["stage3"])`` is the reference's
+    ``net.new_graph(["stage3"])`` — reuse the backbone's variables
+    unchanged and fine-tune a new head on the tapped features."""
+
+    def __init__(self, base: Module, outputs: Sequence[str],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.base = base
+        self.outputs = list(outputs)
+        if not self.outputs:
+            raise ValueError("GraphNet needs at least one output path")
+
+    def _select(self, taps: Dict[str, Any], prefix: str = "") -> Any:
+        sel = []
+        for p in self.outputs:
+            key = f"{prefix}{p}" if prefix else p
+            if key not in taps:
+                close = sorted(k for k in taps if k.endswith(p))
+                if len(close) == 1:
+                    key = close[0]
+                else:
+                    raise KeyError(
+                        f"no submodule output at {p!r}; available: "
+                        f"{sorted(taps)[:20]}")
+            sel.append(taps[key])
+        return sel[0] if len(sel) == 1 else tuple(sel)
+
+    def init(self, rng: jax.Array, *args: Any, **kwargs: Any) -> Params:
+        # variables are the BASE's tree: a pretrained checkpoint loads
+        # straight in, exactly like the reference's shared-weights newGraph
+        return self.base.init(rng, *args, **kwargs)
+
+    def apply(self, variables: Params, *args: Any, training: bool = False,
+              rng: Optional[jax.Array] = None, **kwargs: Any
+              ) -> Tuple[Any, Params]:
+        _, state, taps = self.base.apply_with_taps(
+            variables, *args, training=training, rng=rng, **kwargs)
+        return self._select(taps), state
+
+    def forward(self, scope: Scope, *args: Any, **kwargs: Any) -> Any:
+        # embedded inside another module: run the base as a child with taps
+        # enabled, then select relative to this scope's path
+        had = scope.taps
+        scope.taps = {} if had is None else had
+        try:
+            scope.child(self.base, *args, name="base", **kwargs)
+            prefix = "/".join(scope.path + ("base",)) + "/"
+            return self._select(scope.taps, prefix)
+        finally:
+            scope.taps = had

@@ -165,3 +165,50 @@ class ObjectDetector(ZooModel):
             dets.sort(key=lambda d: -d[1])
             results.append(dets)
         return results
+
+
+class Visualizer:
+    """Draw detections onto images (reference: the objectdetection
+    Visualizer utility — models/image/objectdetection/, which rendered
+    boxes + labels via OpenCV; here PIL on the host).
+
+    ``visualize(image, detections)`` takes one HWC image (uint8 or float
+    in [0,1]/[0,255]) and the per-image output of
+    ``ObjectDetector.predict_image_set`` and returns a uint8 HWC array
+    with boxes and ``label: score`` captions drawn."""
+
+    # a small fixed palette cycled per class label
+    _COLORS = [(230, 25, 75), (60, 180, 75), (255, 225, 25), (0, 130, 200),
+               (245, 130, 48), (145, 30, 180), (70, 240, 240),
+               (240, 50, 230), (210, 245, 60), (250, 190, 190)]
+
+    def __init__(self, score_format: str = "{label}: {score:.2f}"):
+        self.score_format = score_format
+
+    def visualize(self, image: np.ndarray, detections: List[Tuple[Any,
+                  float, np.ndarray]]) -> np.ndarray:
+        from PIL import Image, ImageDraw
+        img = np.asarray(image)
+        if img.dtype != np.uint8:
+            scale = 255.0 if img.max() <= 1.0 + 1e-6 else 1.0
+            img = np.clip(img * scale, 0, 255).astype(np.uint8)
+        pil = Image.fromarray(img)
+        draw = ImageDraw.Draw(pil)
+        color_of: dict = {}
+        for label, score, box in detections:
+            if label not in color_of:
+                color_of[label] = self._COLORS[len(color_of)
+                                               % len(self._COLORS)]
+            color = color_of[label]
+            x1, y1, x2, y2 = [float(v) for v in box]
+            draw.rectangle([x1, y1, x2, y2], outline=color, width=2)
+            draw.text((x1 + 2, max(0.0, y1 - 10)),
+                      self.score_format.format(label=label, score=score),
+                      fill=color)
+        return np.asarray(pil)
+
+    def save(self, path: str, image: np.ndarray,
+             detections: List[Tuple[Any, float, np.ndarray]]) -> str:
+        from PIL import Image
+        Image.fromarray(self.visualize(image, detections)).save(path)
+        return path

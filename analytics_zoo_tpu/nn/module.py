@@ -32,13 +32,15 @@ class Scope:
     """Threads variable access through one ``init`` or ``apply`` trace."""
 
     def __init__(self, params: Params, state: Params, rng: Optional[jax.Array],
-                 training: bool, init_mode: bool, path: Tuple[str, ...] = ()):
+                 training: bool, init_mode: bool, path: Tuple[str, ...] = (),
+                 taps: Optional[Dict[str, Any]] = None):
         self.params = params
         self.state = state
         self.rng = rng
         self.training = training
         self.init_mode = init_mode
         self.path = path
+        self.taps = taps  # shared dict: child outputs recorded by path
         self._rng_count = 0
         self._child_counts: Dict[str, int] = {}
 
@@ -96,10 +98,13 @@ class Scope:
         sub = Scope(sub_params, sub_state,
                     jax.random.fold_in(self.rng, zlib.crc32(name.encode()))
                     if self.rng is not None else None,
-                    self.training, self.init_mode, self.path + (name,))
+                    self.training, self.init_mode, self.path + (name,),
+                    taps=self.taps)
         out = module.forward(sub, *args, **kwargs)
         if not self.init_mode and (sub.state or sub_state_in):
             self.state[name] = sub.state
+        if self.taps is not None:
+            self.taps["/".join(self.path + (name,))] = out
         return out
 
 
@@ -131,6 +136,21 @@ class Module:
                       training, init_mode=False)
         out = self.forward(scope, *args, **kwargs)
         return out, scope.state
+
+    def apply_with_taps(self, variables: Params, *args: Any,
+                        training: bool = False,
+                        rng: Optional[jax.Array] = None, **kwargs: Any
+                        ) -> Tuple[Any, Params, Dict[str, Any]]:
+        """Like ``apply`` but also returns every submodule's output keyed by
+        its scope path ("block0/mha", ...) — the functional analog of the
+        reference's GraphNet intermediate-output surgery
+        (zoo/.../pipeline/api/net/GraphNet.scala ``newGraph``)."""
+        state_in = variables.get("state", {})
+        taps: Dict[str, Any] = {}
+        scope = Scope(variables.get("params", {}), dict(state_in), rng,
+                      training, init_mode=False, taps=taps)
+        out = self.forward(scope, *args, **kwargs)
+        return out, scope.state, taps
 
     def __call__(self, scope_or_vars: Any, *args: Any, **kwargs: Any) -> Any:
         """Inside another module's forward: ``layer(scope, x)`` delegates via

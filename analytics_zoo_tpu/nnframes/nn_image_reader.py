@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Sequence
 
+import numpy as np
+
 
 class NNImageReader:
     @staticmethod
@@ -32,6 +34,10 @@ class NNImageReader:
         rows = []
         for i, p in enumerate(iset.paths):
             img = apply_chain(decode_image(p), list(transforms or []))
+            if img.dtype.kind in "ui":
+                # decode yields uint8; models need float activations (a
+                # uint8 feed would truncate every conv/dense output)
+                img = img.astype(np.float32)
             row = {"image": img, "origin": p, "height": img.shape[0],
                    "width": img.shape[1],
                    "n_channels": img.shape[2] if img.ndim == 3 else 1}

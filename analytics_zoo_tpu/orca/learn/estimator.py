@@ -80,15 +80,24 @@ class ZooEstimator:
                  profile_dir: Optional[str] = None,
                  profile_steps: Any = (10, 20),
                  preemption_checkpoint: bool = False,
-                 preemption_sync_every: int = 10):
+                 preemption_sync_every: int = 10,
+                 frozen: Any = None):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
         "fsdp" (ZeRO-3 over the ``fsdp`` axis), "tp+fsdp", or an explicit
-        list of parallel.ShardingRule."""
+        list of parallel.ShardingRule.
+
+        ``frozen``: transfer-learning freeze (reference: GraphNet.freezeUpTo
+        — SURVEY §2.3 Net loaders): a list of param-path prefixes
+        (e.g. ``["bert"]``) or a predicate ``fn(path_str) -> bool``; matched
+        parameters get zero updates (optax.multi_transform + set_to_zero),
+        which XLA folds into the compiled step."""
         self.model = model
         self.loss_fn = losses_lib.get(loss)
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
+        self.frozen = frozen
+        self._tx_wrapped = False
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self.sharding = sharding
         self.aux_loss_weight = aux_loss_weight
@@ -121,12 +130,34 @@ class ZooEstimator:
 
     # -- state ----------------------------------------------------------------
 
+    def _wrap_frozen_tx(self, params: Any) -> None:
+        """One-time: wrap the optimizer so frozen params get zero updates
+        (with their own empty optimizer state — adamw weight decay must not
+        touch them either)."""
+        if self._tx_wrapped or not self.frozen:
+            return
+        pred = (self.frozen if callable(self.frozen)
+                else lambda p, pre=tuple(self.frozen):
+                any(p.startswith(x) for x in pre))
+        from analytics_zoo_tpu.parallel.sharding import _key_str
+        labels = jax.tree_util.tree_map_with_path(
+            lambda path, l: "freeze"
+            if pred("/".join(_key_str(k) for k in path)) else "train",
+            params)
+        if not any(l == "freeze"
+                   for l in jax.tree_util.tree_leaves(labels)):
+            logger.warning("frozen=%r matched no parameters", self.frozen)
+        self.tx = optax.multi_transform(
+            {"train": self.tx, "freeze": optax.set_to_zero()}, labels)
+        self._tx_wrapped = True
+
     def _ensure_initialized(self, example_x: Any) -> None:
         if self._ts is not None:
             return
         mesh = get_mesh()
         rng = jax.random.PRNGKey(self.seed)
         variables = self.model.init(rng, example_x, training=True)
+        self._wrap_frozen_tx(variables["params"])
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
         if rules:
@@ -192,7 +223,7 @@ class ZooEstimator:
             per_ex = _per_example_loss(loss_fn, out, batch["y"])
             stats = [jnp.stack([(per_ex * mask).sum(), mask.sum()])]
             for m in metrics:
-                stats.append(m.update(out, batch["y"], mask))
+                stats.append(_metric_update(m, out, batch["y"], mask))
             return stats
 
         def pred_step(ts, x):
@@ -232,8 +263,12 @@ class ZooEstimator:
         if (auto_resume and self._ts is None and self.model_dir
                 and ckpt_io.exists(self.model_dir)):
             self.load(self.model_dir)
-            logger.info("auto-resumed from %s at step %d", self.model_dir,
-                        self._py_step)
+            logger.info("auto-resumed from %s at step %d (epoch %d)",
+                        self.model_dir, self._py_step, self._epoch)
+            # treat ``epochs`` as the TOTAL target: a restarted job runs
+            # only the remaining epochs, and feed.epoch(self._epoch)
+            # continues the shuffle-order sequence instead of replaying it
+            epochs = max(0, epochs - self._epoch)
         data = _maybe_select_cols(data, feature_cols, label_cols)
         feed = as_feed(data, batch_size, seed=self.seed)
         trigger = Trigger.get(checkpoint_trigger)
@@ -419,7 +454,8 @@ class ZooEstimator:
         if self._ts is None:
             raise ValueError("nothing to save: model not initialized yet")
         tree = jax.tree_util.tree_map(lambda x: x, self._ts)
-        return ckpt_io.save(path, tree, step=int(self._ts["step"]))
+        return ckpt_io.save(path, tree, step=int(self._ts["step"]),
+                            extra={"epoch": int(self._epoch)})
 
     def load(self, path: Optional[str] = None) -> None:
         path = path or self.model_dir
@@ -429,6 +465,8 @@ class ZooEstimator:
         # cross-host (ZeRO-3) checkpoint is never densely assembled
         tree = ckpt_io.restore(path, mesh=mesh)
         self._py_step = int(np.asarray(tree["step"]))
+        self._epoch = int(ckpt_io.load_extra(path).get("epoch",
+                                                       self._epoch))
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
 
@@ -448,6 +486,7 @@ class ZooEstimator:
                 lambda l: place(l, P()), tree["params"])
         # checkpoint IO stores optax named-tuples as plain tuples; rebuild the
         # real structure (and its shardings) from tx.init and pour leaves in
+        self._wrap_frozen_tx(tree["params"])
         ref_opt = _ensure_on_mesh(jax.jit(self.tx.init)(params), mesh)
         ref_leaves, ref_def = jax.tree_util.tree_flatten(ref_opt)
         saved_leaves = jax.tree_util.tree_leaves(tree["opt_state"])
@@ -500,6 +539,20 @@ def _pad_remainder(rem: Dict[str, Any], feed: Any, mesh) -> Dict[str, Any]:
     return shard_batch(batch, mesh)
 
 
+def _metric_update(m: Any, out: Any, y: Any, mask: jax.Array) -> jax.Array:
+    """Call a metric's update, tolerating user metrics written to the old
+    2-arg ``update(y_pred, y_true)`` contract (their stats then include
+    padded rows; built-ins all take the mask)."""
+    try:
+        import inspect
+        takes_mask = len(inspect.signature(m.update).parameters) >= 3
+    except (TypeError, ValueError):
+        takes_mask = True
+    if takes_mask:
+        return m.update(out, y, mask)
+    return m.update(out, y)
+
+
 def _per_example_loss(loss_fn: Callable, out: Any, y: Any) -> jax.Array:
     """[batch] losses from a mean-reducing loss: vmap each example through
     the loss with a singleton batch dim."""
@@ -512,16 +565,27 @@ def _per_example_loss(loss_fn: Callable, out: Any, y: Any) -> jax.Array:
 
 def _to_local_rows(out: jax.Array) -> np.ndarray:
     """Device output → this process's rows as numpy.  Single-process: the
-    whole batch.  Multihost: the global batch is host-rows concatenated in
-    process order (shard_batch's contract), so slice this process's range
-    after an allgather — np.asarray on a cross-host array would throw."""
+    whole batch.  Multihost: this process's rows already live in its
+    addressable shards (shard_batch's contract: global batch = host-rows
+    concatenated in process order), so assemble them locally — no
+    cross-host transfer on the predict hot path."""
     if jax.process_count() == 1:
         return np.asarray(out)
-    from jax.experimental import multihost_utils
-    full = np.asarray(multihost_utils.process_allgather(out, tiled=True))
-    rows = full.shape[0] // jax.process_count()
-    return full[jax.process_index() * rows:
-                (jax.process_index() + 1) * rows]
+    # dedupe replicas (tp/model axes replicate the batch rows over extra
+    # local devices) by distinct dim-0 index
+    pieces: Dict[int, np.ndarray] = {}
+    for s in out.addressable_shards:
+        start = 0 if not s.index or s.index[0].start is None \
+            else int(s.index[0].start)
+        if start not in pieces:
+            pieces[start] = np.asarray(s.data)
+    rows = np.concatenate([pieces[k] for k in sorted(pieces)], axis=0)
+    local = out.shape[0] // jax.process_count()
+    if rows.shape[0] > local:
+        # output came back replicated (all rows on every host): slice ours
+        return rows[jax.process_index() * local:
+                    (jax.process_index() + 1) * local]
+    return rows
 
 
 def _collect_aux_losses(state: Any) -> jax.Array:

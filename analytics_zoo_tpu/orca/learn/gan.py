@@ -164,6 +164,11 @@ class GANEstimator:
         for epoch in range(epochs):
             d_losses, g_losses = [], []
             for batch in feed.epoch(mesh, epoch):
+                if "mask" in batch:
+                    # padded stream-tail batch: the duplicated pad rows
+                    # would train the discriminator at full weight — skip
+                    # (drop_remainder training semantics, like Estimator)
+                    continue
                 real = batch["x"]
                 self._ensure_initialized(real)
                 for _ in range(self.d_steps):

@@ -99,6 +99,11 @@ def main() -> None:
     np.testing.assert_array_equal(
         np.asarray(k_rep.addressable_shards[0].data), k_dense)
 
+    # predict returns exactly this process's rows, assembled locally
+    preds = est.predict(x_loc[:16], batch_size=16)
+    assert preds.shape == (16, 2), preds.shape
+    assert np.all(np.isfinite(preds))
+
     # iterator feed across processes: strided split + per-batch consensus
     # (unequal local stream lengths; all-masked filler batches)
     from analytics_zoo_tpu.data import from_iterator

@@ -256,3 +256,31 @@ def test_recommend_probability_is_positive_class(rng):
     for r in recs:
         np.testing.assert_allclose(r.probability, 1 - probs[r.item_id, 0],
                                    atol=1e-6)
+
+
+def test_visualizer_draws_boxes():
+    import pytest
+    pytest.importorskip("PIL")
+    from analytics_zoo_tpu.models import Visualizer
+    img = np.zeros((64, 64, 3), np.float32)
+    dets = [("cat", 0.9, np.asarray([8.0, 8.0, 30.0, 30.0])),
+            ("dog", 0.7, np.asarray([35.0, 35.0, 60.0, 60.0]))]
+    out = Visualizer().visualize(img, dets)
+    assert out.shape == (64, 64, 3) and out.dtype == np.uint8
+    assert out.max() > 0  # something was drawn
+
+
+def test_tsdataset_to_feed():
+    import pandas as pd
+    from analytics_zoo_tpu.chronos import TSDataset
+    from analytics_zoo_tpu.core import get_mesh
+    df = pd.DataFrame({
+        "ts": pd.date_range("2026-01-01", periods=80, freq="h"),
+        "value": np.arange(80, dtype=np.float32),
+    })
+    ds = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    ds.roll(lookback=12, horizon=2)
+    feed = ds.to_feed(batch_size=16, shuffle=False)
+    batch = next(feed.epoch(get_mesh(), 0))
+    assert batch["x"].shape == (16, 12, 1)
+    assert batch["y"].shape == (16, 2, 1)
