@@ -30,8 +30,13 @@ class BERT(Module):
                  intermediate_mult: int = 4, max_position: int = 512,
                  type_vocab: int = 2, dropout: float = 0.1,
                  use_flash: bool = False, use_ring: bool = False,
+                 remat: bool = False,
                  dtype: Any = None, name: Optional[str] = None):
+        """``remat``: gradient-checkpoint each encoder block
+        (nn.Remat) — activation memory drops to O(layers * [B,T,H]) at
+        ~1.3x compute, the long-sequence training recipe."""
         super().__init__(name)
+        self.remat = remat
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.n_layers = n_layers
@@ -62,13 +67,17 @@ class BERT(Module):
         if self.dtype is not None:
             x = x.astype(self.dtype)
         for i in range(self.n_layers):
-            x = scope.child(
-                nn.TransformerLayer(self.n_heads,
-                                    hidden_mult=self.intermediate_mult,
-                                    dropout=self.dropout, pre_ln=True,
-                                    use_flash=self.use_flash,
-                                    use_ring=self.use_ring),
-                x, mask=mask, name=f"layer_{i}")
+            block = nn.TransformerLayer(self.n_heads,
+                                        hidden_mult=self.intermediate_mult,
+                                        dropout=self.dropout, pre_ln=True,
+                                        use_flash=self.use_flash,
+                                        use_ring=self.use_ring,
+                                        name=f"layer_{i}")
+            if self.remat:
+                x = scope.child(nn.Remat(block), x, mask=mask,
+                                name=f"remat_{i}")
+            else:
+                x = scope.child(block, x, mask=mask, name=f"layer_{i}")
         return x.astype(jnp.float32)
 
 

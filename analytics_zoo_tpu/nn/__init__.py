@@ -16,11 +16,13 @@ from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
                            GlobalMaxPooling3D, Highway, LeakyReLU,
                            LocallyConnected1D, Masking, MaxoutDense,
                            MaxPooling1D, MaxPooling3D, Maximum, Minimum,
-                           Permute, PReLU, RepeatVector, SeparableConv2D,
+                           Permute, PReLU, Remat, RepeatVector,
+                           SeparableConv2D,
                            SpatialDropout1D, SpatialDropout2D,
                            SpatialDropout3D, Subtract, ThresholdedReLU,
                            UpSampling1D, UpSampling2D, UpSampling3D,
                            ZeroPadding1D, ZeroPadding3D)
+from .functional import Input, Model, SymbolicTensor
 from .module import Module, Scope, param_count
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
 
@@ -45,4 +47,7 @@ __all__ = [
     "GaussianDropout", "LeakyReLU", "ELU", "ThresholdedReLU", "PReLU",
     "Average", "Maximum", "Minimum", "Subtract", "Dot", "Highway",
     "MaxoutDense",
+    # functional graph API
+    "Input", "Model", "SymbolicTensor",
+    "Remat",
 ]

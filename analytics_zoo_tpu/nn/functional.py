@@ -1,0 +1,204 @@
+"""Functional Model API: ``Input`` → layer calls → ``Model(inputs, outputs)``.
+
+Reference (SURVEY.md §2.3): the Keras-1.2 graph API —
+``zoo/.../pipeline/api/keras/models/Topology.scala`` ``Model`` and its py4j
+mirror ``pyzoo/zoo/pipeline/api/keras/models.py`` — was the reference's
+primary model-building surface: multi-input/multi-output DAGs
+(``Model([input1, input2], output)``), layer reuse (shared embeddings),
+KNRM/W&D-style two-tower graphs.
+
+TPU-native: calling a layer on a ``SymbolicTensor`` records a graph node
+instead of computing; ``Model`` topologically executes the recorded DAG
+inside one scope, so the whole graph jit-compiles like any Module.  A
+layer object called twice becomes ONE parameter subtree executed twice —
+weight sharing by object identity, the Keras semantic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .module import Module, Scope, _snake
+
+
+class _Node:
+    """One recorded layer application."""
+
+    def __init__(self, layer: Optional[Module], args: Tuple[Any, ...],
+                 kwargs: Dict[str, Any]):
+        self.layer = layer          # None for Input placeholders
+        self.args = args            # may contain SymbolicTensors (nested)
+        self.kwargs = kwargs
+        self.name: Optional[str] = None  # assigned by Model
+
+
+class SymbolicTensor:
+    """Placeholder flowing through layer calls at graph-build time.  A
+    layer returning a tuple stores it whole — split components with a
+    ``Lambda(lambda t: t[i])`` node."""
+
+    def __init__(self, node: _Node,
+                 shape: Optional[Tuple[int, ...]] = None,
+                 dtype: Any = None):
+        self.node = node
+        self.shape = shape
+        self.dtype = dtype
+
+    # arithmetic sugar: x + y etc. become Lambda nodes
+    def _binop(self, other: Any, fn, name: str) -> "SymbolicTensor":
+        from .layers import Lambda
+        lam = Lambda(fn, name=name)
+        return lam(self, other) if isinstance(other, SymbolicTensor) \
+            else lam(self)
+
+    def __add__(self, other):
+        if isinstance(other, SymbolicTensor):
+            return self._binop(other, lambda a, b: a + b, "add")
+        return self._binop(other, lambda a, o=other: a + o, "add_const")
+
+    def __sub__(self, other):
+        if isinstance(other, SymbolicTensor):
+            return self._binop(other, lambda a, b: a - b, "sub")
+        return self._binop(other, lambda a, o=other: a - o, "sub_const")
+
+    def __mul__(self, other):
+        if isinstance(other, SymbolicTensor):
+            return self._binop(other, lambda a, b: a * b, "mul")
+        return self._binop(other, lambda a, o=other: a * o, "mul_const")
+
+    # constant-on-the-left forms (1.0 + x, 2 * h, 1 - gate)
+    def __radd__(self, other):
+        return self._binop(other, lambda a, o=other: o + a, "radd_const")
+
+    def __rsub__(self, other):
+        return self._binop(other, lambda a, o=other: o - a, "rsub_const")
+
+    def __rmul__(self, other):
+        return self._binop(other, lambda a, o=other: o * a, "rmul_const")
+
+
+def Input(shape: Sequence[int], dtype: Any = jnp.float32,
+          name: Optional[str] = None) -> SymbolicTensor:
+    """A graph input placeholder; ``shape`` excludes the batch dim
+    (reference: keras Input)."""
+    node = _Node(None, (), {"name": name})
+    return SymbolicTensor(node, tuple(shape), dtype)
+
+
+def _contains_symbolic(x: Any) -> bool:
+    if isinstance(x, SymbolicTensor):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(_contains_symbolic(v) for v in x)
+    if isinstance(x, dict):
+        return any(_contains_symbolic(v) for v in x.values())
+    return False
+
+
+def _map_symbolic(x: Any, fn) -> Any:
+    if isinstance(x, SymbolicTensor):
+        return fn(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_map_symbolic(v, fn) for v in x)
+    if isinstance(x, dict):
+        return {k: _map_symbolic(v, fn) for k, v in x.items()}
+    return x
+
+
+def symbolic_call(layer: Module, *args: Any, **kwargs: Any
+                  ) -> SymbolicTensor:
+    """Record ``layer(*args)`` as a graph node (invoked by
+    ``Module.__call__`` when any arg is symbolic)."""
+    return SymbolicTensor(_Node(layer, args, kwargs))
+
+
+class Model(Module):
+    """Execute a recorded DAG (reference: keras Model graph topology).
+
+    ``inputs``: SymbolicTensor or list; ``outputs``: SymbolicTensor or
+    list.  ``forward`` takes the concrete arrays in ``inputs`` order (a
+    single list/tuple argument also works) and returns the outputs
+    (tuple when several)."""
+
+    def __init__(self, inputs: Any, outputs: Any,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.inputs: List[SymbolicTensor] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.outputs: List[SymbolicTensor] = (
+            list(outputs) if isinstance(outputs, (list, tuple))
+            else [outputs])
+        self._order = self._toposort()
+        self._assign_names()
+
+    def _toposort(self) -> List[_Node]:
+        order: List[_Node] = []
+        seen: set = set()
+        input_nodes = {id(s.node) for s in self.inputs}
+
+        def visit(node: _Node, stack: set) -> None:
+            if id(node) in seen:
+                return
+            if id(node) in stack:
+                raise ValueError("cycle in model graph")
+            if id(node) not in input_nodes:
+                if node.layer is None:
+                    raise ValueError(
+                        "graph references an Input that is not in "
+                        "Model(inputs=...)")
+                stack = stack | {id(node)}
+                for sym in self._deps(node):
+                    visit(sym.node, stack)
+            seen.add(id(node))
+            order.append(node)
+
+        for out in self.outputs:
+            visit(out.node, set())
+        return order
+
+    @staticmethod
+    def _deps(node: _Node) -> List[SymbolicTensor]:
+        deps: List[SymbolicTensor] = []
+        _map_symbolic((node.args, node.kwargs), deps.append)
+        return deps
+
+    def _assign_names(self) -> None:
+        # one name per LAYER OBJECT: calling a layer twice shares weights
+        by_layer: Dict[int, str] = {}
+        counts: Dict[str, int] = {}
+        for node in self._order:
+            if node.layer is None:
+                continue
+            key = id(node.layer)
+            if key not in by_layer:
+                base = node.layer.name or _snake(type(node.layer).__name__)
+                idx = counts.get(base, 0)
+                counts[base] = idx + 1
+                by_layer[key] = base if idx == 0 else f"{base}_{idx}"
+            node.name = by_layer[key]
+
+    def forward(self, scope: Scope, *xs: Any, **kwargs: Any) -> Any:
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)) \
+                and len(self.inputs) > 1:
+            xs = tuple(xs[0])
+        if len(xs) != len(self.inputs):
+            raise ValueError(
+                f"model takes {len(self.inputs)} inputs, got {len(xs)}")
+        values: Dict[int, Any] = {}
+        for sym, x in zip(self.inputs, xs):
+            values[id(sym.node)] = x
+
+        def resolve(sym: SymbolicTensor) -> Any:
+            return values[id(sym.node)]
+
+        for node in self._order:
+            if node.layer is None or id(node) in values:
+                continue  # input placeholder / already computed
+            args = _map_symbolic(node.args, resolve)
+            kw = _map_symbolic(node.kwargs, resolve)
+            values[id(node)] = scope.child(node.layer, *args,
+                                           name=node.name, **kw)
+        outs = tuple(resolve(s) for s in self.outputs)
+        return outs[0] if len(outs) == 1 else outs

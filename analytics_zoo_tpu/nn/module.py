@@ -43,6 +43,8 @@ class Scope:
         self.taps = taps  # shared dict: child outputs recorded by path
         self._rng_count = 0
         self._child_counts: Dict[str, int] = {}
+        self._child_seen: Dict[str, int] = {}  # name → id(module)
+        self._reuse = False  # re-executing a shared layer: params exist
 
     # -- variables ------------------------------------------------------------
 
@@ -50,6 +52,8 @@ class Scope:
               dtype: Any = jnp.float32) -> jax.Array:
         if self.init_mode:
             if name in self.params:
+                if self._reuse:  # shared layer re-executed: same weights
+                    return self.params[name]
                 raise ValueError(f"duplicate param {name!r} at {self.path}")
             self.params[name] = initializer(self.make_rng(), tuple(shape), dtype)
         if name not in self.params:
@@ -100,11 +104,29 @@ class Scope:
                     if self.rng is not None else None,
                     self.training, self.init_mode, self.path + (name,),
                     taps=self.taps)
+        # weight sharing: re-executing the SAME layer object under the same
+        # name (a shared layer in a functional graph) reuses its params; a
+        # DIFFERENT module under an already-used name is a naming bug and
+        # keeps the duplicate-param guard
+        prev = self._child_seen.get(name)
+        if prev is not None and prev != id(module) and self.init_mode \
+                and not self._reuse:
+            raise ValueError(
+                f"two different modules share the child name {name!r} at "
+                f"{'/'.join(self.path) or '<root>'}; give them distinct "
+                "names (weight sharing requires the same layer object)")
+        sub._reuse = self._reuse or prev == id(module)
+        self._child_seen[name] = id(module)
         out = module.forward(sub, *args, **kwargs)
         if not self.init_mode and (sub.state or sub_state_in):
             self.state[name] = sub.state
         if self.taps is not None:
-            self.taps["/".join(self.path + (name,))] = out
+            key = base_key = "/".join(self.path + (name,))
+            i = 1
+            while key in self.taps:  # shared layer: one tap per application
+                key = f"{base_key}#{i}"
+                i += 1
+            self.taps[key] = out
         return out
 
 
@@ -154,9 +176,17 @@ class Module:
 
     def __call__(self, scope_or_vars: Any, *args: Any, **kwargs: Any) -> Any:
         """Inside another module's forward: ``layer(scope, x)`` delegates via
-        the parent scope (auto-named child).  Outside: alias for apply."""
-        if isinstance(scope_or_vars, Scope):
+        the parent scope (auto-named child).  On SymbolicTensors: records a
+        functional-graph node (nn.functional).  Outside: alias for apply."""
+        if isinstance(scope_or_vars, Scope):  # the hot path: no import
             return scope_or_vars.child(self, *args, **kwargs)
+        # a symbolic arg can only be the input itself or a (nested) list of
+        # inputs — never inside a variables dict, so dicts are not walked
+        from .functional import _contains_symbolic, symbolic_call
+        maybe = (scope_or_vars,) + args
+        if any(not isinstance(m, dict) and _contains_symbolic(m)
+               for m in maybe):
+            return symbolic_call(self, scope_or_vars, *args, **kwargs)
         return self.apply(scope_or_vars, *args, **kwargs)
 
     # convenience

@@ -38,6 +38,21 @@ def test_bert_finetune_example():
     assert "validation:" in proc.stdout
 
 
+def test_ncf_friesian_example():
+    pytest.importorskip("pandas")
+    proc = _run("ncf_friesian.py", "--epochs", "1", "--batch-size", "128")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "test:" in proc.stdout and "top-3" in proc.stdout
+
+
+def test_resnet_imageset_example():
+    pytest.importorskip("PIL")
+    proc = _run("resnet_imageset.py", "--epochs", "1", "--batch-size", "16",
+                "--image-size", "32", "--num-workers", "2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "train-set eval:" in proc.stdout
+
+
 def test_chronos_autots_example():
     pytest.importorskip("pandas")
     proc = _run("chronos_autots.py", "--epochs", "1", "--n-sampling", "1")

@@ -1,0 +1,156 @@
+"""Functional Model API tests (reference: keras Model graph topology —
+Topology.scala Model + pyzoo keras models.py; two-tower/shared-weights
+graphs were the reference's main model-building surface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn as nn
+from analytics_zoo_tpu.core import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context("local")
+    yield
+
+
+def test_single_input_graph_matches_sequential():
+    inp = nn.Input((8,))
+    h = nn.Dense(16, activation="relu", name="d1")(inp)
+    out = nn.Dense(2, name="d2")(h)
+    model = nn.Model(inp, out)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8)),
+                    jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y, _ = model.apply(variables, x)
+    assert y.shape == (4, 2)
+    assert set(variables["params"]) == {"d1", "d2"}
+
+
+def test_multi_input_two_tower():
+    user = nn.Input((6,))
+    item = nn.Input((5,))
+    u = nn.Dense(8, activation="relu")(user)
+    v = nn.Dense(8, activation="relu")(item)
+    merged = nn.Concatenate()([u, v])
+    out = nn.Dense(1)(merged)
+    model = nn.Model([user, item], out)
+    rng = np.random.default_rng(1)
+    xu = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    xi = jnp.asarray(rng.normal(size=(4, 5)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), xu, xi)
+    y, _ = model.apply(variables, xu, xi)
+    assert y.shape == (4, 1)
+
+
+def test_shared_layer_weights():
+    # one Dense object applied to two inputs: ONE param subtree
+    shared = nn.Dense(4, use_bias=False, name="shared")
+    a = nn.Input((3,))
+    b = nn.Input((3,))
+    out = nn.Add()([shared(a), shared(b)])
+    model = nn.Model([a, b], out)
+    xa = jnp.ones((2, 3))
+    xb = jnp.zeros((2, 3))
+    variables = model.init(jax.random.PRNGKey(0), xa, xb)
+    flat = jax.tree_util.tree_leaves(variables["params"])
+    assert len(flat) == 1  # a single shared kernel
+    y, _ = model.apply(variables, xa, xb)
+    # Add(shared(ones), shared(zeros)) == shared(ones)
+    w = variables["params"]["shared"]["kernel"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(xa @ w),
+                               rtol=1e-6)
+
+
+def test_multi_output_graph():
+    inp = nn.Input((4,))
+    h = nn.Dense(8, activation="relu")(inp)
+    out1 = nn.Dense(2, name="head_a")(h)
+    out2 = nn.Dense(3, name="head_b")(h)
+    model = nn.Model(inp, [out1, out2])
+    x = jnp.ones((2, 4))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    (ya, yb), _ = model.apply(variables, x)
+    assert ya.shape == (2, 2) and yb.shape == (2, 3)
+
+
+def test_symbolic_arithmetic_residual():
+    inp = nn.Input((6,))
+    h = nn.Dense(6, name="res")(inp)
+    out = h + inp  # residual via operator sugar
+    model = nn.Model(inp, out)
+    x = jnp.ones((2, 6))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y, _ = model.apply(variables, x)
+    _, _, taps = model.apply_with_taps(variables, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(taps["res"] + x), rtol=1e-6)
+
+
+def test_functional_model_trains_in_estimator():
+    from analytics_zoo_tpu.orca.learn import Estimator
+    inp = nn.Input((8,))
+    h = nn.Dense(16, activation="relu")(inp)
+    out = nn.Dense(2)(h)
+    model = nn.Model(inp, out)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               learning_rate=5e-2, metrics=["accuracy"])
+    hist = est.fit((x, y), epochs=5, batch_size=16, verbose=False)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert est.evaluate((x, y), batch_size=16)["accuracy"] > 0.8
+
+
+def test_reflected_operators():
+    inp = nn.Input((4,))
+    gate = nn.Dense(4, name="g")(inp)
+    out = 1.0 - gate  # constant on the left (keras gate-inversion idiom)
+    model = nn.Model(inp, out)
+    x = jnp.zeros((2, 4))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y, _ = model.apply(variables, x)
+    _, _, taps = model.apply_with_taps(variables, x)
+    np.testing.assert_allclose(np.asarray(y), 1.0 - np.asarray(taps["g"]),
+                               rtol=1e-6)
+    # 2 * h and 1.0 + h build without TypeError too
+    nn.Model(inp, 2 * gate)
+    nn.Model(inp, 1.0 + gate)
+
+
+def test_same_name_different_modules_raises():
+    class Bad(nn.Module):
+        def forward(self, scope, x):
+            h = scope.child(nn.Dense(4), x, name="h")
+            return scope.child(nn.Dense(8), h, name="h")  # name slip
+
+    with pytest.raises(ValueError, match="different modules"):
+        Bad().init(jax.random.PRNGKey(0), jnp.ones((2, 3)))
+
+
+def test_shared_layer_taps_keep_both_applications():
+    shared = nn.Dense(4, use_bias=False, name="shared")
+    a = nn.Input((3,))
+    b = nn.Input((3,))
+    out = nn.Add()([shared(a), shared(b)])
+    model = nn.Model([a, b], out)
+    xa, xb = jnp.ones((2, 3)), jnp.zeros((2, 3))
+    variables = model.init(jax.random.PRNGKey(0), xa, xb)
+    _, _, taps = model.apply_with_taps(variables, xa, xb)
+    keys = [k for k in taps if k.startswith("shared")]
+    assert len(keys) == 2, sorted(taps)  # one tap per application
+    vals = sorted(float(np.abs(np.asarray(taps[k])).sum()) for k in keys)
+    assert vals[0] == 0.0 and vals[1] > 0.0  # zeros-tower and ones-tower
+
+
+def test_unlisted_input_raises():
+    a = nn.Input((3,))
+    b = nn.Input((3,))
+    out = nn.Add()([nn.Dense(2)(a), nn.Dense(2)(b)])
+    with pytest.raises(ValueError, match="not in"):
+        nn.Model(a, out)  # b is reachable but not declared

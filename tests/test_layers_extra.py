@@ -162,3 +162,54 @@ def test_locally_connected_positions_independent():
     out2, _ = layer.apply(variables, x[:, ::-1])
     assert not np.allclose(np.asarray(out1)[:, ::-1], np.asarray(out2),
                            atol=1e-4)
+
+def test_remat_matches_plain_forward_and_grad():
+    import analytics_zoo_tpu.nn as nn2
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    inner = nn2.Dense(8, activation="tanh", name="d")
+    remat = nn2.Remat(inner)
+    variables = remat.init(jax.random.PRNGKey(0), x)
+    # identical forward under the same variables
+    out_r, _ = remat.apply(variables, x)
+    out_p, _ = inner.apply({"params": variables["params"]["d"]}, x)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_p),
+                               rtol=1e-6)
+
+    def loss_plain(p):
+        out, _ = inner.apply({"params": p["d"]}, x)
+        return jnp.sum(out ** 2)
+
+    def loss_remat(p):
+        out, _ = remat.apply({"params": p}, x)
+        return jnp.sum(out ** 2)
+
+    g1 = jax.grad(loss_plain)(variables["params"])
+    g2 = jax.grad(loss_remat)(variables["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-6),
+        g1, g2)
+
+
+def test_bert_remat_trains():
+    from analytics_zoo_tpu.models import BERT
+    from analytics_zoo_tpu.orca.learn import Estimator
+    import analytics_zoo_tpu.nn as nn2
+
+    class Clf(nn2.Module):
+        def __init__(self):
+            super().__init__()
+            self.bert = BERT(vocab_size=40, hidden_size=32, n_layers=2,
+                             n_heads=2, max_position=16, remat=True)
+
+        def forward(self, scope, ids):
+            h = scope.child(self.bert, ids, name="bert")
+            return scope.child(nn2.Dense(2), h[:, 0], name="head")
+
+    rng = np.random.default_rng(10)
+    x = rng.integers(0, 40, (16, 12)).astype(np.int32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    est = Estimator.from_keras(Clf(), loss="sparse_categorical_crossentropy")
+    hist = est.fit((x, y), epochs=1, batch_size=8, verbose=False)
+    assert np.isfinite(hist["loss"][0])
