@@ -14,8 +14,9 @@ from .mtnet import MTNetForecaster
 from .tcmf import TCMFForecaster
 from .detector import AEDetector, DBScanDetector, ThresholdDetector
 from .autots import AutoTSEstimator, TSPipeline
+from .experimental import XShardsTSDataset
 
-__all__ = ["TSDataset", "LSTMForecaster", "Seq2SeqForecaster",
+__all__ = ["TSDataset", "XShardsTSDataset", "LSTMForecaster", "Seq2SeqForecaster",
            "TCNForecaster", "MTNetForecaster", "TCMFForecaster",
            "ARIMAForecaster", "ProphetForecaster",
            "AEDetector", "DBScanDetector", "ThresholdDetector",

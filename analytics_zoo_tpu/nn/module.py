@@ -196,6 +196,51 @@ class Module:
         out, _ = self.apply(variables, *args, **kwargs)
         return variables, out
 
+    def summary(self, variables: Params, *args: Any,
+                print_fn: Optional[Callable[[str], None]] = print,
+                **kwargs: Any) -> str:
+        """Keras-style layer table: path, output shape, param count
+        (reference: KerasNet.summary — Topology.scala).  Shapes come from
+        an abstract trace (jax.eval_shape) — no compute, no activation
+        memory."""
+        _, _, taps = jax.eval_shape(
+            lambda v, *a: self.apply_with_taps(v, *a, **kwargs),
+            variables, *args)
+
+        def count(tree: Any) -> int:
+            return sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(tree)
+                       if hasattr(l, "shape"))
+
+        def shape_of(out: Any) -> str:
+            leaves = [l for l in jax.tree_util.tree_leaves(out)
+                      if hasattr(l, "shape")]
+            if not leaves:
+                return "-"
+            s = ", ".join(str(tuple(l.shape)) for l in leaves[:3])
+            return s + (", ..." if len(leaves) > 3 else "")
+
+        params = variables.get("params", {})
+        rows = [("layer (path)", "output shape", "params")]
+        for path in sorted(taps):
+            # param counts are reported on top-level rows only (nested rows
+            # would double-count their parent's subtree)
+            top_level = "/" not in path and "#" not in path
+            sub = params.get(path, {}) if top_level else None
+            rows.append((path, shape_of(taps[path]),
+                         str(count(sub)) if sub is not None else ""))
+        total = count(params)
+        widths = [max(len(r[i]) for r in rows) for i in range(3)]
+        lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+                 for r in rows]
+        lines.insert(1, "-" * (sum(widths) + 4))
+        lines.append("-" * (sum(widths) + 4))
+        lines.append(f"total params: {total:,}")
+        text = "\n".join(lines)
+        if print_fn:
+            print_fn(text)
+        return text
+
 
 def _snake(s: str) -> str:
     out = []

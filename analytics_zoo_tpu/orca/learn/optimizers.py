@@ -1,12 +1,54 @@
 """Optimizer resolution (reference: Orca optimizer wrappers,
 pyzoo/zoo/orca/learn/optimizers.py — SGD/Adam/AdamW/RMSprop etc. mapped onto
-BigDL OptimMethods).  Here they map onto optax gradient transformations."""
+BigDL OptimMethods).  Here they map onto optax gradient transformations.
+
+Learning-rate schedules (reference: BigDL LearningRateSchedule — Poly,
+Exponential, Warmup, SequentialSchedule — set via optimMethod): pass a
+plain float, an optax schedule callable, or a dict spec, e.g.
+``learning_rate={"schedule": "warmup_cosine", "peak": 1e-3,
+"warmup_steps": 100, "decay_steps": 1000}``.
+"""
 
 from __future__ import annotations
 
 from typing import Any, Optional, Union
 
 import optax
+
+_SCHEDULES = {
+    # reference Poly(power, maxIteration)
+    "poly": lambda lr, decay_steps, power=1.0, end_lr=0.0, **kw:
+        optax.polynomial_schedule(lr, end_lr, power, decay_steps, **kw),
+    # reference Exponential(decayStep, decayRate)
+    "exponential": lambda lr, decay_steps, decay_rate=0.96, **kw:
+        optax.exponential_decay(lr, decay_steps, decay_rate, **kw),
+    # reference Warmup(delta) + cosine tail (the modern default)
+    "warmup_cosine": lambda lr, warmup_steps, decay_steps, end_lr=0.0, **kw:
+        optax.warmup_cosine_decay_schedule(0.0, lr, warmup_steps,
+                                           decay_steps, end_lr, **kw),
+    "warmup_linear": lambda lr, warmup_steps, **kw:
+        optax.linear_schedule(0.0, lr, warmup_steps, **kw),
+    "cosine": lambda lr, decay_steps, **kw:
+        optax.cosine_decay_schedule(lr, decay_steps, **kw),
+    "constant": lambda lr, **kw: optax.constant_schedule(lr),
+}
+
+
+def resolve_learning_rate(learning_rate: Any) -> Any:
+    """float/callable pass through; dict specs become optax schedules."""
+    if not isinstance(learning_rate, dict):
+        return learning_rate
+    spec = dict(learning_rate)
+    name = spec.pop("schedule")
+    lr = spec.pop("peak", spec.pop("lr", None))
+    if lr is None:
+        raise ValueError("schedule spec needs a 'peak' (or 'lr') entry")
+    try:
+        return _SCHEDULES[name](lr, **spec)
+    except KeyError:
+        raise ValueError(f"unknown schedule {name!r}; known: "
+                         f"{sorted(_SCHEDULES)}") from None
+
 
 _FACTORIES = {
     "sgd": lambda lr, **kw: optax.sgd(lr, **kw),
@@ -34,6 +76,7 @@ def get(optimizer: Union[str, optax.GradientTransformation, None],
     """
     if optimizer is None:
         optimizer = "adam"
+    learning_rate = resolve_learning_rate(learning_rate)
     if isinstance(optimizer, str):
         name = optimizer.lower()
         if name not in _FACTORIES:

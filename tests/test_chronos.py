@@ -299,3 +299,100 @@ def test_tcmf_forecaster_panel_round_trip(tmp_path):
     fc.save(path)
     fc2 = TCMFForecaster.load(path)
     np.testing.assert_allclose(fc2.predict(horizon=6), pred, atol=1e-4)
+
+
+def test_xshards_tsdataset_global_scaling_matches_single_frame():
+    """Distributed scale must use GLOBAL statistics: per-shard scaling would
+    give different numbers (reference: experimental XShardsTSDataset)."""
+    from analytics_zoo_tpu.chronos import TSDataset, XShardsTSDataset
+    rng = np.random.default_rng(0)
+    frames = []
+    for sid, base in (("a", 0.0), ("b", 100.0), ("c", -50.0)):
+        frames.append(pd.DataFrame({
+            "ts": pd.date_range("2026-01-01", periods=60, freq="h"),
+            "id": sid,
+            "value": (base + rng.normal(0, 1, 60)).astype(np.float64),
+        }))
+    full = pd.concat(frames, ignore_index=True)
+
+    dist = XShardsTSDataset.from_pandas(full, dt_col="ts",
+                                        target_col="value", id_col="id",
+                                        num_shards=3)
+    dist = dist.scale("standard")
+    single = TSDataset.from_pandas(full, dt_col="ts", target_col="value",
+                                   id_col="id").scale("standard")
+
+    dist.roll(lookback=8, horizon=2)
+    single.roll(8, 2)
+    xd, yd = dist.to_numpy()
+    xs, ys = single.to_numpy()
+    assert xd.shape == xs.shape and yd.shape == ys.shape
+    # same global scaler → identical values (row order may differ by shard;
+    # compare sorted flattened)
+    np.testing.assert_allclose(np.sort(xd.ravel()), np.sort(xs.ravel()),
+                               rtol=1e-6)
+    # unscale round-trips
+    back = dist.unscale_numpy(yd)
+    assert back.std() > 10  # original spread restored
+
+
+def test_xshards_tsdataset_to_feed_and_forecaster():
+    from analytics_zoo_tpu.chronos import LSTMForecaster, XShardsTSDataset
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({
+        "ts": np.tile(pd.date_range("2026-01-01", periods=50, freq="h"), 2),
+        "id": np.repeat(["x", "y"], 50),
+        "value": rng.normal(size=100).astype(np.float64),
+    })
+    ds = XShardsTSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                                      id_col="id", num_shards=2)
+    ds = ds.impute().scale("minmax")
+    ds.roll(lookback=10, horizon=1)
+    x, y = ds.to_numpy()
+    assert x.shape[1:] == (10, 1) and y.shape[1:] == (1, 1)
+    fc = LSTMForecaster(past_seq_len=10, future_seq_len=1,
+                        input_feature_num=1, output_feature_num=1)
+    fc.fit((x, y), epochs=1, batch_size=16)
+    assert fc.predict(x[:4]).shape == (4, 1, 1)
+
+
+def test_xshards_scale_with_nans_matches_single_frame():
+    from analytics_zoo_tpu.chronos import TSDataset, XShardsTSDataset
+    rng = np.random.default_rng(3)
+    vals = rng.normal(10, 2, 90)
+    vals[::7] = np.nan  # pre-impute scaling must use non-NaN counts
+    df = pd.DataFrame({
+        "ts": np.tile(pd.date_range("2026-01-01", periods=30, freq="h"), 3),
+        "id": np.repeat(["a", "b", "c"], 30),
+        "value": vals,
+    })
+    dist = XShardsTSDataset.from_pandas(df, dt_col="ts",
+                                        target_col="value", id_col="id",
+                                        num_shards=3).scale("standard")
+    single = TSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                                   id_col="id").scale("standard")
+    np.testing.assert_allclose(float(dist.scaler["mean"]["value"]),
+                               float(single.scaler["mean"]["value"]),
+                               rtol=1e-9)
+    np.testing.assert_allclose(float(dist.scaler["std"]["value"]),
+                               float(single.scaler["std"]["value"]),
+                               rtol=1e-9)
+
+
+def test_xshards_roll_drops_short_shards():
+    from analytics_zoo_tpu.chronos import XShardsTSDataset
+    rng = np.random.default_rng(4)
+    frames = {
+        "long": pd.DataFrame({
+            "ts": pd.date_range("2026-01-01", periods=40, freq="h"),
+            "id": "long", "value": rng.normal(size=40)}),
+        "short": pd.DataFrame({
+            "ts": pd.date_range("2026-01-01", periods=5, freq="h"),
+            "id": "short", "value": rng.normal(size=5)}),
+    }
+    df = pd.concat(frames.values(), ignore_index=True)
+    ds = XShardsTSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                                      id_col="id", num_shards=2)
+    ds.roll(lookback=8, horizon=2)
+    x, y = ds.to_numpy()  # only the long shard contributes — no crash
+    assert len(x) == 40 - 8 - 2 + 1
