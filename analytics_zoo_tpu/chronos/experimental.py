@@ -72,17 +72,24 @@ class XShardsTSDataset:
     # -- per-shard ops (embarrassingly parallel) ------------------------------
 
     def _map(self, fn) -> "XShardsTSDataset":
+        """Apply a TSDataset op per shard, IN PLACE (TSDataset semantics:
+        ops mutate and return self, so ``ds.scale(...)`` without
+        reassignment behaves identically on both classes)."""
         cfg = self._cfg
+        feature_cols: List[Any] = []
 
         def run(df: pd.DataFrame) -> pd.DataFrame:
             ts = _make_ts(df, cfg)
             fn(ts)
+            if not feature_cols:  # ops may ADD feature columns (dt feats)
+                feature_cols.append(list(ts.feature_col))
             return ts.df
 
-        out = XShardsTSDataset(self.shards.transform_shard(run),
-                               **self._cfg)
-        out.scaler = self.scaler
-        return out
+        self.shards = self.shards.transform_shard(run)
+        if feature_cols and feature_cols[0] != list(
+                self._cfg["extra_feature_col"] or []):
+            self._cfg["extra_feature_col"] = feature_cols[0]
+        return self
 
     def impute(self, mode: str = "last") -> "XShardsTSDataset":
         return self._map(lambda ts: ts.impute(mode))
@@ -133,9 +140,10 @@ class XShardsTSDataset:
         elif self.scaler is None:
             raise ValueError("fit=False requires a previously fit scaler")
         s = self.scaler
-        # pass 2: the single-frame TSDataset applies a fitted dict scaler
-        # itself — one implementation of the formulas, not two
-        return self._map(lambda ts: ts.scale(s))
+        # pass 2 (in place): the single-frame TSDataset applies a fitted
+        # dict scaler itself — one implementation of the formulas, not two
+        self._map(lambda ts: ts.scale(s))
+        return self
 
     def unscale_numpy(self, arr: np.ndarray) -> np.ndarray:
         ts = TSDataset(pd.DataFrame(columns=[self._cfg["dt_col"]]),

@@ -222,7 +222,7 @@ class Module:
 
         params = variables.get("params", {})
         rows = [("layer (path)", "output shape", "params")]
-        for path in sorted(taps):
+        for path in taps:  # insertion order == execution order
             # param counts are reported on top-level rows only (nested rows
             # would double-count their parent's subtree)
             top_level = "/" not in path and "#" not in path

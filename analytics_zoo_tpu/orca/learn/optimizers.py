@@ -39,15 +39,18 @@ def resolve_learning_rate(learning_rate: Any) -> Any:
     if not isinstance(learning_rate, dict):
         return learning_rate
     spec = dict(learning_rate)
-    name = spec.pop("schedule")
+    name = spec.pop("schedule", None)
+    if name is None:
+        raise ValueError("schedule spec needs a 'schedule' entry, e.g. "
+                         f"{{'schedule': 'warmup_cosine', ...}}; known: "
+                         f"{sorted(_SCHEDULES)}")
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown schedule {name!r}; known: "
+                         f"{sorted(_SCHEDULES)}")
     lr = spec.pop("peak", spec.pop("lr", None))
     if lr is None:
         raise ValueError("schedule spec needs a 'peak' (or 'lr') entry")
-    try:
-        return _SCHEDULES[name](lr, **spec)
-    except KeyError:
-        raise ValueError(f"unknown schedule {name!r}; known: "
-                         f"{sorted(_SCHEDULES)}") from None
+    return _SCHEDULES[name](lr, **spec)
 
 
 _FACTORIES = {

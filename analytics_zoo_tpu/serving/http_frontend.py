@@ -42,6 +42,8 @@ class HTTPFrontend:
         self._conn_lock = threading.Lock()
         self._connect()
         self.query_timeout = query_timeout
+        self._stats_lock = threading.Lock()
+        self._stats = {"requests": 0, "errors": 0, "timeouts": 0}
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,6 +61,9 @@ class HTTPFrontend:
             def do_GET(self):
                 if self.path in ("/", "/health"):
                     self._json(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    with frontend._stats_lock:
+                        self._json(200, dict(frontend._stats))
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -66,23 +71,28 @@ class HTTPFrontend:
                 if self.path != "/predict":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
+                frontend._bump("requests")  # every attempt, not just 200s
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
                     arr = np.asarray(req["instances"],
                                      dtype=req.get("dtype", "float32"))
                 except (KeyError, ValueError, TypeError) as e:
+                    frontend._bump("errors")
                     self._json(400, {"error": f"bad request: {e}"})
                     return
                 try:
                     out = frontend.predict(arr)
                 except RuntimeError as e:  # serving-side error reply
+                    frontend._bump("errors")
                     self._json(500, {"error": str(e)})
                     return
                 except OSError as e:  # backend unreachable even after retry
+                    frontend._bump("errors")
                     self._json(503, {"error": f"serving unreachable: {e}"})
                     return
                 if out is None:
+                    frontend._bump("timeouts")
                     self._json(504, {"error": "serving timed out"})
                     return
                 self._json(200, {"predictions": out.tolist()})
@@ -90,6 +100,10 @@ class HTTPFrontend:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def _bump(self, key: str) -> None:
+        with self._stats_lock:
+            self._stats[key] += 1
 
     def _connect(self) -> None:
         self._in = InputQueue(*self._serving_addr)

@@ -66,6 +66,28 @@ class ClusterServing:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # observability (reference: the Flink job's metrics): monotonically
+        # increasing counters, read via stats()
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._replies = 0
+        self._batches = 0
+        self._errors = 0
+        self._batch_rows = 0
+
+    def stats(self) -> Dict[str, Any]:
+        """Service counters: requests seen, replies sent, batches run,
+        errors, and the realized mean batch size (micro-batching health)."""
+        with self._stats_lock:
+            return {"requests": self._requests, "replies": self._replies,
+                    "batches": self._batches, "errors": self._errors,
+                    "mean_batch_size": (self._batch_rows / self._batches
+                                        if self._batches else 0.0)}
+
+    def _count(self, **deltas: int) -> None:
+        with self._stats_lock:
+            for k, v in deltas.items():
+                setattr(self, f"_{k}", getattr(self, f"_{k}") + v)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -121,6 +143,7 @@ class ClusterServing:
                     return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())
+                self._count(requests=1)
                 if arr is None:
                     # protocol-legal but not servable: a header-only frame
                     # has no tensor to batch — reject here rather than let
@@ -195,12 +218,15 @@ class ClusterServing:
                               []).append(p)
         for _, group in groups.items():
             x = np.stack([p.arr for p in group])
+            self._count(batches=1, batch_rows=len(group))
             try:
                 out = self.model.predict(x)
                 for p, row in zip(group, out):
                     self._reply(p, {"uuid": p.uuid}, row)
+                self._count(replies=len(group))
             except Exception as e:  # noqa: BLE001 — report to the client
                 logger.warning("inference failed: %s", e)
+                self._count(errors=len(group))
                 for p in group:
                     self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
 

@@ -396,3 +396,35 @@ def test_xshards_roll_drops_short_shards():
     ds.roll(lookback=8, horizon=2)
     x, y = ds.to_numpy()  # only the long shard contributes — no crash
     assert len(x) == 40 - 8 - 2 + 1
+
+
+def test_xshards_gen_dt_feature_flows_into_roll():
+    from analytics_zoo_tpu.chronos import TSDataset, XShardsTSDataset
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({
+        "ts": pd.date_range("2026-01-01", periods=50, freq="h"),
+        "value": rng.normal(size=50),
+    })
+    dist = XShardsTSDataset.from_pandas(df, dt_col="ts",
+                                        target_col="value")
+    dist.gen_dt_feature().roll(8, 1)
+    xd, _ = dist.to_numpy()
+    single = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    single.gen_dt_feature().roll(8, 1)
+    xs, _ = single.to_numpy()
+    assert xd.shape == xs.shape  # calendar features included, same as local
+    assert xd.shape[-1] > 1
+
+
+def test_xshards_scale_in_place():
+    from analytics_zoo_tpu.chronos import XShardsTSDataset
+    rng = np.random.default_rng(6)
+    df = pd.DataFrame({
+        "ts": pd.date_range("2026-01-01", periods=40, freq="h"),
+        "value": rng.normal(1000.0, 5.0, 40),
+    })
+    ds = XShardsTSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    ds.scale("standard")  # TSDataset semantics: mutates, no reassignment
+    ds.roll(8, 1)
+    x, _ = ds.to_numpy()
+    assert abs(float(x.mean())) < 1.0  # scaled, not raw ~1000

@@ -246,3 +246,23 @@ def test_http_frontend_reconnects_after_backend_restart(inference_model):
     finally:
         fe.stop()
         srv.stop()
+
+
+def test_serving_and_frontend_stats(inference_model):
+    with ClusterServing(inference_model, batch_size=4) as srv:
+        with HTTPFrontend(srv.host, srv.port) as fe:
+            url = f"http://{fe.host}:{fe.port}"
+            for _ in range(3):
+                req = urllib.request.Request(
+                    url + "/predict",
+                    data=json.dumps({"instances": [[1, 2, 3, 4]]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30):
+                    pass
+            with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+                fstats = json.load(r)
+            assert fstats["requests"] == 3 and fstats["timeouts"] == 0
+        s = srv.stats()
+        assert s["requests"] == 3 and s["replies"] == 3
+        assert s["batches"] >= 1 and s["errors"] == 0
+        assert 1.0 <= s["mean_batch_size"] <= 4.0
