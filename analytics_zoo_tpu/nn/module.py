@@ -203,9 +203,16 @@ class Module:
         (reference: KerasNet.summary — Topology.scala).  Shapes come from
         an abstract trace (jax.eval_shape) — no compute, no activation
         memory."""
-        _, _, taps = jax.eval_shape(
-            lambda v, *a: self.apply_with_taps(v, *a, **kwargs),
-            variables, *args)
+        exec_order: List[str] = []
+
+        def traced(v, *a):
+            out, state, taps = self.apply_with_taps(v, *a, **kwargs)
+            # pytree round-trips sort dict keys; execution order must be
+            # captured as a trace side effect (the trace runs exactly once)
+            exec_order.extend(taps.keys())
+            return out, state, taps
+
+        _, _, taps = jax.eval_shape(traced, variables, *args)
 
         def count(tree: Any) -> int:
             return sum(int(np.prod(l.shape)) for l in
@@ -222,7 +229,7 @@ class Module:
 
         params = variables.get("params", {})
         rows = [("layer (path)", "output shape", "params")]
-        for path in taps:  # insertion order == execution order
+        for path in exec_order:
             # param counts are reported on top-level rows only (nested rows
             # would double-count their parent's subtree)
             top_level = "/" not in path and "#" not in path

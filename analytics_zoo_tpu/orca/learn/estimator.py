@@ -508,6 +508,17 @@ class ZooEstimator:
         if self._train_step is None:
             self._build_steps(mesh)
 
+    def get_train_summary(self, tag: str = "loss"):
+        """[(step, value)] scalars from the configured log_dir (reference:
+        Estimator.get_train_summary — BigDL TrainSummary readback)."""
+        if self._writer is None:
+            raise ValueError("no log_dir configured")
+        return self._writer.read_scalar(tag)
+
+    def get_validation_summary(self, tag: str):
+        return self.get_train_summary(f"val_{tag}"
+                                      if not tag.startswith("val_") else tag)
+
     def get_model(self) -> Dict[str, Any]:
         """The current variables {"params", "state"} (host copies)."""
         if self._ts is None:

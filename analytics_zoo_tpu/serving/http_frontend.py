@@ -62,8 +62,9 @@ class HTTPFrontend:
                 if self.path in ("/", "/health"):
                     self._json(200, {"status": "ok"})
                 elif self.path == "/stats":
-                    with frontend._stats_lock:
-                        self._json(200, dict(frontend._stats))
+                    with frontend._stats_lock:  # copy only; write outside
+                        snapshot = dict(frontend._stats)
+                    self._json(200, snapshot)
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 

@@ -67,27 +67,26 @@ class ClusterServing:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # observability (reference: the Flink job's metrics): monotonically
-        # increasing counters, read via stats()
+        # increasing counters, read via stats().  Invariant on a healthy
+        # server: requests == replies + errors once in-flight work drains.
         self._stats_lock = threading.Lock()
-        self._requests = 0
-        self._replies = 0
-        self._batches = 0
-        self._errors = 0
-        self._batch_rows = 0
+        self._counters = {"requests": 0, "replies": 0, "batches": 0,
+                          "errors": 0, "batch_rows": 0}
 
     def stats(self) -> Dict[str, Any]:
         """Service counters: requests seen, replies sent, batches run,
-        errors, and the realized mean batch size (micro-batching health)."""
+        errors (any non-success reply), and the realized mean batch size
+        (micro-batching health)."""
         with self._stats_lock:
-            return {"requests": self._requests, "replies": self._replies,
-                    "batches": self._batches, "errors": self._errors,
-                    "mean_batch_size": (self._batch_rows / self._batches
-                                        if self._batches else 0.0)}
+            c = dict(self._counters)
+        c["mean_batch_size"] = (c.pop("batch_rows") / c["batches"]
+                                if c["batches"] else 0.0)
+        return c
 
     def _count(self, **deltas: int) -> None:
         with self._stats_lock:
             for k, v in deltas.items():
-                setattr(self, f"_{k}", getattr(self, f"_{k}") + v)
+                self._counters[k] += v  # unknown keys fail loudly
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -148,6 +147,7 @@ class ClusterServing:
                     # protocol-legal but not servable: a header-only frame
                     # has no tensor to batch — reject here rather than let
                     # it poison the batcher thread
+                    self._count(errors=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
                             {"uuid": uid, "error": "no tensor in request"}))
@@ -161,6 +161,7 @@ class ClusterServing:
                 if not ok:  # back-pressure: reject instead of dropping
                     with self._pending_lock:
                         self._pending.pop(rid, None)
+                    self._count(errors=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
                             {"uuid": uid, "error": "queue full"}))
@@ -202,6 +203,7 @@ class ClusterServing:
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — batcher must survive
                 logger.warning("batch failed: %s", e)
+                self._count(errors=len(batch))
                 for p in batch:
                     self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
 

@@ -213,3 +213,21 @@ def test_profiler_trace_written(tmp_path, rng):
     assert not est._profiling
     found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
     assert found, "no profiler trace files written"
+
+
+def test_summary_readback(tmp_path, rng):
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse",
+                               metrics=["mae"], log_dir=str(tmp_path),
+                               app_name="t")
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.zeros((32, 1), np.float32)
+    est.fit((x, y), epochs=3, batch_size=16, validation_data=(x, y),
+            verbose=False)
+    train = est.get_train_summary("loss")
+    assert len(train) == 3 and all(np.isfinite(v) for _, v in train)
+    val = est.get_validation_summary("mae")
+    assert len(val) == 3

@@ -107,3 +107,41 @@ def test_feature_table_trains_neuralcf():
     x = data.to_numpy_dict(["user", "item"])["x"]
     preds = est.predict(x[:16].astype(np.int32), batch_size=16)
     assert preds.shape == (16, 2)
+
+
+def test_cross_columns_feed_wide_and_deep():
+    """W&D BASELINE config's wide half: Friesian crosses -> WideAndDeep
+    (reference: friesian cross_columns + WideAndDeep wide_cross_dims)."""
+    from analytics_zoo_tpu.models import WideAndDeep
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    n, cross_dim = 96, 16
+    df = pd.DataFrame({
+        "user": [f"u{i}" for i in rng.integers(0, 12, n)],
+        "item": [f"i{i}" for i in rng.integers(0, 9, n)],
+        "age": rng.normal(35, 10, n).astype(np.float64),
+        "label": rng.integers(0, 2, n),
+    })
+    tbl = FeatureTable.from_pandas(df)
+    tbl, idxs = tbl.encode_string(["user", "item"])
+    tbl = tbl.cross_columns([["user", "item"]], [cross_dim])
+    out = tbl.to_pandas()
+    # layout: [wide cross multi-hot | embed ids (user,item) | continuous]
+    wide = np.zeros((n, cross_dim), np.float32)
+    wide[np.arange(n), out["user_item"].to_numpy()] = 1.0
+    x = np.concatenate([
+        wide,
+        out[["user", "item"]].to_numpy(np.float32),
+        out[["age"]].to_numpy(np.float32),
+    ], axis=1)
+    y = out["label"].to_numpy(np.int32)
+    model = WideAndDeep(class_num=2, wide_cross_dims=[cross_dim],
+                        embed_in_dims=[idxs[0].size, idxs[1].size],
+                        embed_out_dims=[8, 8], continuous_cols=1)
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2, metrics=["accuracy"])
+    hist = est.fit((x, y), epochs=2, batch_size=32, verbose=False)
+    assert np.isfinite(hist["loss"][-1])
+    assert est.predict(x, batch_size=32).shape == (n, 2)

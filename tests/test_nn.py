@@ -323,3 +323,15 @@ def test_module_summary():
     assert "fc1" in text and "fc2" in text
     assert "(4, 16)" in text and "(4, 2)" in text
     assert "total params:" in text
+
+
+def test_module_summary_execution_order():
+    import analytics_zoo_tpu.nn as _nn
+    import jax as _jax
+    # names chosen so lexicographic != execution order
+    model = _nn.Sequential([_nn.Dense(4, name="zz_first"),
+                            _nn.Dense(2, name="aa_second")])
+    x = jnp.ones((2, 8))
+    variables = model.init(_jax.random.PRNGKey(0), x)
+    text = model.summary(variables, x, print_fn=None)
+    assert text.index("zz_first") < text.index("aa_second")

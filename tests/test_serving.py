@@ -266,3 +266,17 @@ def test_serving_and_frontend_stats(inference_model):
         assert s["requests"] == 3 and s["replies"] == 3
         assert s["batches"] >= 1 and s["errors"] == 0
         assert 1.0 <= s["mean_batch_size"] <= 4.0
+
+
+def test_inference_model_bf16_serving_dtype():
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    m = nn.Sequential([nn.Dense(8, activation="relu"), nn.Dense(3)])
+    v = m.init(jax.random.PRNGKey(0), np.ones((1, 4), np.float32))
+    f32 = InferenceModel().load(m, v)
+    bf16 = InferenceModel().load(m, v, dtype=jnp.bfloat16)
+    x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+    a, b = f32.predict(x), bf16.predict(x)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)  # bf16 tolerance
+    assert not np.allclose(a, b, rtol=1e-7, atol=0)  # actually lower precision
