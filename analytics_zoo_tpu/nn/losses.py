@@ -71,6 +71,28 @@ def hinge(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
     return jnp.maximum(0.0, 1.0 - y_true * y_pred).mean()
 
 
+def squared_hinge(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    return jnp.square(jnp.maximum(0.0, 1.0 - y_true * y_pred)).mean()
+
+
+def mean_absolute_percentage_error(y_pred: jax.Array, y_true: jax.Array
+                                   ) -> jax.Array:
+    diff = jnp.abs((y_true - y_pred) /
+                   jnp.clip(jnp.abs(y_true), 1e-7, None))
+    return 100.0 * diff.mean()
+
+
+def mean_squared_logarithmic_error(y_pred: jax.Array, y_true: jax.Array
+                                   ) -> jax.Array:
+    a = jnp.log1p(jnp.clip(y_pred, 0.0, None))
+    b = jnp.log1p(jnp.clip(y_true, 0.0, None))
+    return jnp.square(a - b).mean()
+
+
+def poisson(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
+    return (y_pred - y_true * jnp.log(jnp.clip(y_pred, 1e-7, None))).mean()
+
+
 def kld(y_pred: jax.Array, y_true: jax.Array) -> jax.Array:
     p = jnp.clip(y_true, 1e-7, 1.0)
     q = jnp.clip(y_pred, 1e-7, 1.0)
@@ -93,6 +115,12 @@ LOSSES = {
     "mean_absolute_error": mean_absolute_error,
     "huber": huber,
     "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "poisson": poisson,
     "kld": kld,
     "cosine_proximity": cosine_proximity,
 }

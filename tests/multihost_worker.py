@@ -29,9 +29,12 @@ def main() -> None:
     import analytics_zoo_tpu.nn as nn
     from analytics_zoo_tpu.orca.learn import Estimator
 
+    import os
+    expected = int(os.environ.get("ZOO_NUM_PROCESSES", "2"))
     init_orca_context("multihost", mesh_shape={"data": 1, "fsdp": 0})
-    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_count() == expected, jax.process_count()
     pid = jax.process_index()
+    nproc = jax.process_count()
 
     model = nn.Sequential([
         nn.Dense(32, activation="relu"),
@@ -39,11 +42,12 @@ def main() -> None:
         nn.Dense(2),
     ])
 
-    # identical global dataset on both processes; each contributes its half
+    # identical global dataset on every process; each contributes its slice
     rng = np.random.default_rng(0)
     x_all = rng.normal(size=(64, 8)).astype(np.float32)
     y_all = (x_all.sum(axis=1) > 0).astype(np.int32)
-    lo, hi = pid * 32, (pid + 1) * 32
+    per = 64 // nproc
+    lo, hi = pid * per, (pid + 1) * per
     x_loc, y_loc = x_all[lo:hi], y_all[lo:hi]
 
     est = Estimator.from_keras(model,

@@ -335,3 +335,18 @@ def test_module_summary_execution_order():
     variables = model.init(_jax.random.PRNGKey(0), x)
     text = model.summary(variables, x, print_fn=None)
     assert text.index("zz_first") < text.index("aa_second")
+
+
+def test_extended_loss_functions():
+    from analytics_zoo_tpu.nn import losses
+    yp = jnp.asarray([[2.0], [0.5]])
+    yt = jnp.asarray([[1.0], [1.0]])
+    assert float(losses.get("squared_hinge")(yp, yt)) >= 0.0
+    mape = float(losses.get("mape")(yp, yt))
+    np.testing.assert_allclose(mape, 100 * (1.0 + 0.5) / 2, rtol=1e-5)
+    msle = float(losses.get("msle")(yp, yt))
+    assert msle > 0
+    poisson = float(losses.get("poisson")(yp, yt))
+    np.testing.assert_allclose(
+        poisson, float(np.mean([2 - np.log(2), 0.5 - np.log(0.5)])),
+        rtol=1e-5)
