@@ -11,13 +11,14 @@ from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Sequential, ZeroPadding2D)
 from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
                            Conv2DTranspose, Conv3D, Cropping1D, Cropping2D,
-                           DepthwiseConv2D, Dot, ELU, GaussianDropout,
+                           Cropping3D, DepthwiseConv2D, Dot, ELU,
+                           GaussianDropout,
                            GaussianNoise, GlobalAveragePooling3D,
                            GlobalMaxPooling3D, Highway, LeakyReLU,
                            LocallyConnected1D, Masking, MaxoutDense,
                            MaxPooling1D, MaxPooling3D, Maximum, Minimum,
-                           Permute, PReLU, Remat, RepeatVector,
-                           SeparableConv2D,
+                           Narrow, Permute, PReLU, Remat, RepeatVector,
+                           Select, SeparableConv2D, SReLU, Squeeze,
                            SpatialDropout1D, SpatialDropout2D,
                            SpatialDropout3D, Subtract, ThresholdedReLU,
                            UpSampling1D, UpSampling2D, UpSampling3D,
@@ -50,4 +51,5 @@ __all__ = [
     # functional graph API
     "Input", "Model", "SymbolicTensor",
     "Remat",
+    "Cropping3D", "SReLU", "Select", "Narrow", "Squeeze",
 ]

@@ -24,6 +24,15 @@ def _triple(v: Union[int, Sequence[int]]) -> Tuple[int, int, int]:
     return (v, v, v) if isinstance(v, int) else tuple(v)  # type: ignore
 
 
+def _norm_cropping(cropping: Union[int, Sequence[Any]], ndim: int
+                   ) -> Tuple[Tuple[int, int], ...]:
+    """int → symmetric per-dim; per-dim entries may be int or (lo, hi)."""
+    if isinstance(cropping, int):
+        return ((cropping, cropping),) * ndim
+    return tuple((c, c) if isinstance(c, int) else tuple(c)
+                 for c in cropping)
+
+
 # -- convolution variants ------------------------------------------------------
 
 class Conv3D(Module):
@@ -321,15 +330,23 @@ class Cropping1D(Module):
         return x[:, a:x.shape[1] - b]
 
 
+class Cropping3D(Module):
+    def __init__(self, cropping: Union[int, Sequence[Any]] = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cropping = _norm_cropping(cropping, 3)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return x[:, d0:x.shape[1] - d1, h0:x.shape[2] - h1,
+                 w0:x.shape[3] - w1]
+
+
 class Cropping2D(Module):
     def __init__(self, cropping: Union[int, Sequence[Any]] = 1,
                  name: Optional[str] = None):
         super().__init__(name)
-        if isinstance(cropping, int):
-            self.cropping = ((cropping, cropping), (cropping, cropping))
-        else:
-            self.cropping = tuple(
-                (c, c) if isinstance(c, int) else tuple(c) for c in cropping)
+        self.cropping = _norm_cropping(cropping, 2)
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         (t, b), (l, r) = self.cropping
@@ -462,6 +479,80 @@ class ThresholdedReLU(Module):
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         return jnp.where(x > self.theta, x, 0.0)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with four learnable params per channel (reference:
+    BigDL/keras-1 SReLU): piecewise-linear with learned thresholds/slopes
+    at both tails."""
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        c = (x.shape[-1],)
+        zeros = initializers.get("zeros")
+        ones = initializers.get("ones")
+        tl = scope.param("t_left", zeros, c).astype(x.dtype)
+        al = scope.param("a_left", zeros, c).astype(x.dtype)
+        tr = scope.param("t_right", ones, c).astype(x.dtype)
+        ar = scope.param("a_right", ones, c).astype(x.dtype)
+        below = tl + al * (x - tl)
+        above = tr + ar * (x - tr)
+        mid = x
+        return jnp.where(x < tl, below, jnp.where(x > tr, above, mid))
+
+
+# -- BigDL tensor-op layers (reference: zoo keras layers wrapping BigDL
+#    Select/Narrow/Squeeze/Permute-style tensor utilities) -------------------
+
+class Select(Module):
+    """Pick index ``index`` along ``dim`` (reference: BigDL Select)."""
+
+    def __init__(self, dim: int, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+        self.index = index
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        size = x.shape[self.dim]
+        if not -size <= self.index < size:
+            # fail fast: jnp.take's default OOB mode fills NaN silently
+            raise ValueError(
+                f"Select index {self.index} out of range for dim "
+                f"{self.dim} of size {size}")
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+class Narrow(Module):
+    """Slice ``length`` elements from ``offset`` along ``dim``;
+    ``length=-1`` means "to the end" (reference: BigDL Narrow)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+        self.offset = offset
+        self.length = length
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        stop = (x.shape[self.dim] if self.length == -1
+                else self.offset + self.length)
+        return jax.lax.slice_in_dim(x, self.offset, stop, axis=self.dim)
+
+
+class Squeeze(Module):
+    """Drop size-1 dims; the batch dim (axis 0) is never squeezed —
+    a batch of one must stay a batch (reference: BigDL Squeeze, which
+    operated on per-sample tensors without a batch axis)."""
+
+    def __init__(self, dim: Optional[Union[int, Sequence[int]]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        if self.dim is not None:
+            return jnp.squeeze(x, axis=self.dim)
+        axes = tuple(i for i in range(1, x.ndim) if x.shape[i] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
 
 
 class PReLU(Module):

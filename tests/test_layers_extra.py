@@ -213,3 +213,40 @@ def test_bert_remat_trains():
     est = Estimator.from_keras(Clf(), loss="sparse_categorical_crossentropy")
     hist = est.fit((x, y), epochs=1, batch_size=8, verbose=False)
     assert np.isfinite(hist["loss"][0])
+
+
+@pytest.mark.parametrize("layer,shape,expect", [
+    (nn.Cropping3D(1), (2, 5, 6, 7, 3), (2, 3, 4, 5, 3)),
+    (nn.SReLU(), (2, 5), (2, 5)),
+    (nn.Select(dim=1, index=2), (2, 5, 3), (2, 3)),
+    (nn.Narrow(dim=1, offset=1, length=3), (2, 6, 4), (2, 3, 4)),
+    (nn.Squeeze(dim=2), (2, 5, 1, 3), (2, 5, 3)),
+])
+def test_tensor_op_layer_shapes(layer, shape, expect):
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    assert _run(layer, x).shape == expect
+
+
+def test_srelu_identity_between_thresholds():
+    # init: t_left=0, a_left=0, t_right=1, a_right=1 → identity on [0, 1]
+    x = jnp.asarray([[0.2, 0.8]])
+    np.testing.assert_allclose(_run(nn.SReLU(), x), x, rtol=1e-6)
+    # below t_left: clamps to t_left + 0*(x-t) = 0
+    neg = jnp.asarray([[-3.0, -0.5]])
+    np.testing.assert_allclose(_run(nn.SReLU(), neg), np.zeros((1, 2)),
+                               atol=1e-6)
+
+
+def test_squeeze_preserves_batch_of_one():
+    x = jnp.zeros((1, 4, 1, 3))
+    out = _run(nn.Squeeze(), x)
+    assert out.shape == (1, 4, 3)  # axis 0 kept even at batch size 1
+
+
+def test_narrow_length_to_end_and_select_oob():
+    x = jnp.arange(12, dtype=jnp.float32).reshape(2, 6)
+    out = _run(nn.Narrow(dim=1, offset=2, length=-1), x)
+    np.testing.assert_array_equal(out, np.arange(12).reshape(2, 6)[:, 2:])
+    with pytest.raises(ValueError, match="out of range"):
+        _run(nn.Select(dim=1, index=99), x)
