@@ -47,10 +47,15 @@ class AutoEstimator:
             search_space: Optional[Dict[str, Any]] = None,
             scheduler: Optional[ASHAScheduler] = None,
             seed: int = 0) -> "AutoEstimator":
-        """Search; then keep the best trained estimator."""
+        """Search; then keep the best trained estimator.
+
+        ``scheduler``: an ASHAScheduler, or the string "asha" for default
+        ASHA settings (reference: tune scheduler names)."""
         from analytics_zoo_tpu.orca.learn import Estimator
         search_space = dict(search_space or {})
         val = validation_data if validation_data is not None else data
+        if scheduler == "asha":
+            scheduler = ASHAScheduler(metric_mode=self.metric_mode)
         engine = self.engine or RandomSearchEngine(
             metric_mode=self.metric_mode, scheduler=scheduler, seed=seed)
         self.engine = engine

@@ -68,6 +68,13 @@ class ZooModel(Module):
              "rng": jax.random.PRNGKey(est.seed)}, repl)
         est._build_steps(mesh)
 
+    def set_estimator(self, estimator: Any) -> "ZooModel":
+        """Attach an externally built estimator (e.g. one configured with
+        custom sharding/frozen settings) instead of compile()'s default."""
+        self._estimator = estimator
+        self._inject_loaded_weights()
+        return self
+
     @property
     def estimator(self):
         if getattr(self, "_estimator", None) is None:

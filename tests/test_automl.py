@@ -97,3 +97,23 @@ def test_auto_estimator_end_to_end(rng):
     assert cfg["hidden"] in (4, 8)
     est = auto.get_best_estimator()
     assert est.evaluate((x, y), batch_size=16)["mse"] < 10.0
+
+
+def test_auto_estimator_asha_string():
+    import numpy as np
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.automl import AutoEstimator, hp
+
+    def model_fn(config):
+        return nn.Sequential([nn.Dense(int(config["units"]),
+                                       activation="relu"), nn.Dense(1)])
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    auto = AutoEstimator(model_fn, loss="mse")
+    auto.fit((x, y), epochs=2, batch_size=16, n_sampling=3,
+             search_space={"units": hp.choice([8, 16]),
+                           "lr": hp.loguniform(1e-3, 1e-1)},
+             scheduler="asha")
+    assert auto.get_best_model() is not None
