@@ -53,6 +53,14 @@ def test_resnet_imageset_example():
     assert "train-set eval:" in proc.stdout
 
 
+def test_cluster_serving_example():
+    proc = _run("cluster_serving.py")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "TCP client prediction:" in proc.stdout
+    assert "HTTP client prediction:" in proc.stdout
+    assert "service stats:" in proc.stdout
+
+
 def test_chronos_autots_example():
     pytest.importorskip("pandas")
     proc = _run("chronos_autots.py", "--epochs", "1", "--n-sampling", "1")
