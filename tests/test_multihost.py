@@ -51,3 +51,24 @@ def test_multiprocess_fit_eval_sharded_checkpoint(tmp_path, nprocs,
     for pid in range(nprocs):
         assert any(n.startswith("shards_") and n.endswith(f"_p{pid}.npz")
                    for n in names), (pid, names)
+
+
+def test_zoo_launch_cli(tmp_path):
+    """The zoo-launch console entry point end-to-end (simulation mode)."""
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import jax\n"
+        "from analytics_zoo_tpu.core import init_orca_context\n"
+        "init_orca_context('multihost', mesh_shape={'data': 0})\n"
+        "print(f'LAUNCH_OK {jax.process_index()}/{jax.process_count()} "
+        "{jax.device_count()}')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.core.launcher",
+         "--nprocs", "2", "--devices-per-proc", "2", "--platform", "cpu",
+         str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "LAUNCH_OK 0/2 4" in proc.stdout
+    assert "LAUNCH_OK 1/2 4" in proc.stdout
