@@ -73,6 +73,15 @@ class ClusterServing:
         self._counters = {"requests": 0, "replies": 0, "batches": 0,
                           "errors": 0, "batch_rows": 0}
 
+    def update_model(self, model: InferenceModel) -> None:
+        """Hot-swap the serving model without dropping connections
+        (reference: cluster serving's model-update flow — a new model
+        version replaced the loaded one between batches).  In-flight
+        batches finish on the old model; the next batch uses the new one
+        (a single reference assignment, atomic under the GIL)."""
+        self.model = model
+        logger.info("ClusterServing model updated")
+
     def stats(self) -> Dict[str, Any]:
         """Service counters: requests seen, replies sent, batches run,
         errors (any non-success reply), and the realized mean batch size

@@ -280,3 +280,25 @@ def test_inference_model_bf16_serving_dtype():
     a, b = f32.predict(x), bf16.predict(x)
     np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)  # bf16 tolerance
     assert not np.allclose(a, b, rtol=1e-7, atol=0)  # actually lower precision
+
+
+def test_update_model_hot_swap():
+    import jax
+    import analytics_zoo_tpu.nn as nn
+
+    def make(bias_val):
+        m = nn.Sequential([nn.Lambda(lambda x: x * 0.0 + bias_val)])
+        v = m.init(jax.random.PRNGKey(0), np.ones((1, 4), np.float32))
+        return InferenceModel().load(m, v)
+
+    with ClusterServing(make(1.0), batch_size=4) as srv:
+        q = InputQueue(srv.host, srv.port)
+        out_q = OutputQueue(input_queue=q)
+        uid = q.enqueue("a", t=np.ones(4, np.float32))
+        before = out_q.query(uid, timeout=30)
+        np.testing.assert_allclose(before, np.ones(4), rtol=1e-6)
+        srv.update_model(make(2.0))  # hot-swap on the SAME connection
+        uid2 = q.enqueue("b", t=np.ones(4, np.float32))
+        after = out_q.query(uid2, timeout=30)
+        np.testing.assert_allclose(after, np.full(4, 2.0), rtol=1e-6)
+        q.close()
