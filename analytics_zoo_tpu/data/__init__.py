@@ -5,7 +5,8 @@ from .readers import read_csv, read_json, read_npz, read_parquet
 from .shards import XShards
 from .stream import StreamingDataFeed
 from .image import (ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop,
-                    ImageRandomFlip, ImageNormalize)
+                    ImageRandomFlip, ImageNormalize, ImageBrightness,
+                    ImageContrast, ImageSaturation, ImageColorJitter)
 from .text import TextSet
 from .interop import (IterableDataFeed, from_iterator, from_tf_dataset,
                       from_torch_dataset, from_torch_dataloader)
@@ -17,7 +18,8 @@ __all__ = [
     "XShards", "DataFeed", "as_feed", "batch_sharding", "shard_batch",
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
     "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
-    "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "TextSet",
+    "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "ImageBrightness",
+    "ImageContrast", "ImageSaturation", "ImageColorJitter", "TextSet",
     "IterableDataFeed", "from_iterator", "from_tf_dataset",
     "from_torch_dataset", "from_torch_dataloader",
 ]

@@ -102,6 +102,76 @@ class ImageNormalize:
         return (img.astype(np.float32) / 255.0 - self.mean) / self.std
 
 
+class ImageBrightness:
+    """Random additive brightness jitter in [-delta, delta] (reference:
+    image/Brightness).  Operates on uint8 pre-normalize."""
+
+    def __init__(self, delta: float = 32.0):
+        self.delta = float(delta)
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        shift = rng.uniform(-self.delta, self.delta)
+        return np.clip(img.astype(np.float32) + shift, 0, 255).astype(
+            img.dtype)
+
+
+class ImageContrast:
+    """Random contrast scale in [lower, upper] about the mean (reference:
+    image/Contrast)."""
+
+    def __init__(self, lower: float = 0.5, upper: float = 1.5):
+        self.lower, self.upper = float(lower), float(upper)
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        scale = rng.uniform(self.lower, self.upper)
+        f = img.astype(np.float32)
+        mean = f.mean(axis=(0, 1), keepdims=True)
+        return np.clip((f - mean) * scale + mean, 0, 255).astype(img.dtype)
+
+
+class ImageSaturation:
+    """Random saturation scale (blend with per-pixel luma; reference:
+    image/Saturation)."""
+
+    _LUMA = np.asarray([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, lower: float = 0.5, upper: float = 1.5):
+        self.lower, self.upper = float(lower), float(upper)
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        scale = rng.uniform(self.lower, self.upper)
+        f = img.astype(np.float32)
+        gray = (f[..., :3] @ self._LUMA)[..., None]
+        out = gray + (f - gray) * scale
+        return np.clip(out, 0, 255).astype(img.dtype)
+
+
+class ImageColorJitter:
+    """Brightness + contrast + saturation in random order per sample
+    (reference: the ColorJitter chain the detection pipelines used)."""
+
+    def __init__(self, brightness: float = 32.0,
+                 contrast: Sequence[float] = (0.5, 1.5),
+                 saturation: Sequence[float] = (0.5, 1.5)):
+        self.stages = [ImageBrightness(brightness),
+                       ImageContrast(*contrast),
+                       ImageSaturation(*saturation)]
+
+    def __call__(self, img: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        order = rng.permutation(len(self.stages))
+        for i in order:
+            img = self.stages[i](img, rng=rng)
+        return img
+
+
 def decode_image(path: str) -> np.ndarray:
     """File → uint8 HWC RGB (reference: OpenCV imdecode behind JNI; here
     PIL on the host — the chip never sees undecoded bytes)."""

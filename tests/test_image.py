@@ -166,3 +166,33 @@ def test_predict_on_streaming_feed_covers_all_rows(tmp_path):
                                  batch_size=8, shuffle=True)
     with pytest.raises(ValueError, match="shuffle=False"):
         est.predict(shuffled, batch_size=8)
+
+
+def test_color_jitter_transforms():
+    from analytics_zoo_tpu.data import (ImageBrightness, ImageColorJitter,
+                                        ImageContrast, ImageSaturation)
+    rng = np.random.default_rng(0)
+    img = rng.integers(40, 200, (16, 16, 3)).astype(np.uint8)
+    for t in (ImageBrightness(32), ImageContrast(), ImageSaturation(),
+              ImageColorJitter()):
+        out = t(img, rng=np.random.default_rng(1))
+        assert out.shape == img.shape and out.dtype == np.uint8
+        # deterministic under the same rng (streaming-feed reproducibility)
+        out2 = t(img, rng=np.random.default_rng(1))
+        np.testing.assert_array_equal(out, out2)
+    # fixed-range value checks (no dependence on what the rng draws):
+    # contrast 2x about the mean
+    con = ImageContrast(2.0, 2.0)(img, rng=np.random.default_rng(2))
+    f = img.astype(np.float32)
+    want = np.clip((f - f.mean((0, 1), keepdims=True)) * 2.0
+                   + f.mean((0, 1), keepdims=True), 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(con, want)
+    # gray image is a fixed point of saturation
+    gray = np.full((8, 8, 3), 100, np.uint8)
+    sat = ImageSaturation(0.2, 0.2)(gray, rng=np.random.default_rng(3))
+    np.testing.assert_allclose(sat, gray, atol=1)
+    # jitter with wide ranges changes a varied image
+    jit = ImageColorJitter(brightness=50, contrast=(1.9, 2.0),
+                           saturation=(1.9, 2.0))(
+        img, rng=np.random.default_rng(4))
+    assert not np.array_equal(jit, img)
