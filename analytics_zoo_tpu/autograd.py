@@ -84,6 +84,8 @@ class CustomLoss:
         out = self.loss_func(y_true, y_pred)
         return jnp.mean(out)
 
-    # reference spelling: loss.forward(y_true, y_pred)
+    # reference spelling: loss.forward(y_true, y_pred).  Returns the jnp
+    # scalar (not float()) so it stays traceable under jit/grad; callers
+    # can cast eagerly if they want a host number.
     def forward(self, y_true: jax.Array, y_pred: jax.Array) -> jax.Array:
-        return float(self(y_pred, y_true))
+        return self(y_pred, y_true)

@@ -60,8 +60,12 @@ class PreemptionGuard:
                  signals=(signal.SIGTERM, signal.SIGINT)):
         self.sync_every = max(1, sync_every)
         self.active = False   # True only inside fit(): flag-and-continue
+        # Plain bool, NO lock: the handler runs on the main thread between
+        # bytecodes, so a lock shared with main-thread readers can deadlock
+        # the process exactly during preemption.  A bool store/load is atomic
+        # under the GIL.
         self._flag = False
-        self._lock = threading.Lock()
+        self._pending_signum = 0  # logged lazily, outside the handler
         self._prev_handlers = {}
         self._installed = False
         self._signals = signals
@@ -104,15 +108,22 @@ class PreemptionGuard:
                 signal.signal(signum, signal.SIG_DFL)
                 signal.raise_signal(signum)
             return
-        with self._lock:
-            self._flag = True
-        logger.warning("received signal %d: checkpoint at next sync point",
-                       signum)
+        # Async-signal-safe body: no locks (incl. the logging module's) —
+        # just two atomic stores.  The warning is emitted from flagged/
+        # should_checkpoint on the next ordinary read.
+        self._pending_signum = signum
+        self._flag = True
+
+    def _drain_log(self) -> None:
+        signum, self._pending_signum = self._pending_signum, 0
+        if signum:
+            logger.warning(
+                "received signal %d: checkpoint at next sync point", signum)
 
     @property
     def flagged(self) -> bool:
-        with self._lock:
-            return self._flag
+        self._drain_log()
+        return self._flag
 
     def should_checkpoint(self, step: int) -> bool:
         if step % self.sync_every != 0:

@@ -43,7 +43,10 @@ class Scope:
         self.taps = taps  # shared dict: child outputs recorded by path
         self._rng_count = 0
         self._child_counts: Dict[str, int] = {}
-        self._child_seen: Dict[str, int] = {}  # name → id(module)
+        # name → module object.  The object itself (not id()) is kept so the
+        # identity check can't false-positive when CPython reuses a freed
+        # module's address for a new one.
+        self._child_seen: Dict[str, "Module"] = {}
         self._reuse = False  # re-executing a shared layer: params exist
 
     # -- variables ------------------------------------------------------------
@@ -109,14 +112,14 @@ class Scope:
         # DIFFERENT module under an already-used name is a naming bug and
         # keeps the duplicate-param guard
         prev = self._child_seen.get(name)
-        if prev is not None and prev != id(module) and self.init_mode \
+        if prev is not None and prev is not module and self.init_mode \
                 and not self._reuse:
             raise ValueError(
                 f"two different modules share the child name {name!r} at "
                 f"{'/'.join(self.path) or '<root>'}; give them distinct "
                 "names (weight sharing requires the same layer object)")
-        sub._reuse = self._reuse or prev == id(module)
-        self._child_seen[name] = id(module)
+        sub._reuse = self._reuse or prev is module
+        self._child_seen[name] = module
         out = module.forward(sub, *args, **kwargs)
         if not self.init_mode and (sub.state or sub_state_in):
             self.state[name] = sub.state

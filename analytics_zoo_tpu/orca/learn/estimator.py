@@ -136,9 +136,11 @@ class ZooEstimator:
         touch them either)."""
         if self._tx_wrapped or not self.frozen:
             return
+        # match on path-component boundaries so frozen=["bert"] does not
+        # also freeze siblings like "bert_head/..." or "bert2/..."
         pred = (self.frozen if callable(self.frozen)
                 else lambda p, pre=tuple(self.frozen):
-                any(p.startswith(x) for x in pre))
+                any(p == x or p.startswith(x + "/") for x in pre))
         from analytics_zoo_tpu.parallel.sharding import _key_str
         labels = jax.tree_util.tree_map_with_path(
             lambda path, l: "freeze"

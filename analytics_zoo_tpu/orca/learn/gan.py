@@ -163,12 +163,14 @@ class GANEstimator:
         history: Dict[str, List[float]] = {"d_loss": [], "g_loss": []}
         for epoch in range(epochs):
             d_losses, g_losses = [], []
+            n_batches = 0
             for batch in feed.epoch(mesh, epoch):
                 if "mask" in batch:
                     # padded stream-tail batch: the duplicated pad rows
                     # would train the discriminator at full weight — skip
                     # (drop_remainder training semantics, like Estimator)
                     continue
+                n_batches += 1
                 real = batch["x"]
                 self._ensure_initialized(real)
                 for _ in range(self.d_steps):
@@ -177,8 +179,19 @@ class GANEstimator:
                 for _ in range(self.g_steps):
                     self._ts, gl = self._g_step(self._ts, real)
                     g_losses.append(gl)
-            history["d_loss"].append(float(jnp.stack(d_losses).mean()))
-            history["g_loss"].append(float(jnp.stack(g_losses).mean()))
+            if n_batches == 0:
+                raise ValueError(
+                    "epoch produced no full batches: dataset smaller than "
+                    f"batch_size={batch_size} (masked tail batches are "
+                    "skipped in training) — lower batch_size or add data")
+            # d_steps=0 / g_steps=0 (pretraining one side) leaves that
+            # loss list empty: record nan rather than stack([])
+            history["d_loss"].append(
+                float(jnp.stack(d_losses).mean()) if d_losses
+                else float("nan"))
+            history["g_loss"].append(
+                float(jnp.stack(g_losses).mean()) if g_losses
+                else float("nan"))
             if verbose:
                 logger.info("epoch %d: d_loss=%.4f g_loss=%.4f", epoch + 1,
                             history["d_loss"][-1], history["g_loss"][-1])

@@ -154,3 +154,19 @@ def test_unlisted_input_raises():
     out = nn.Add()([nn.Dense(2)(a), nn.Dense(2)(b)])
     with pytest.raises(ValueError, match="not in"):
         nn.Model(a, out)  # b is reachable but not declared
+def test_child_seen_holds_reference_not_id():
+    """Regression (round-2 advisor): the duplicate-name guard must keep the
+    module OBJECT alive, not just id() — a GC'd module's address can be
+    reused by a different module, silently defeating the guard."""
+    import gc
+
+    class TwoInline(nn.Module):
+        def forward(self, scope, x):
+            # first module constructed inline: without a kept reference it
+            # would be collectible right after its child() call
+            h = scope.child(nn.Dense(4), x, name="h")
+            gc.collect()
+            return scope.child(nn.Dense(8), h, name="h")  # different module
+
+    with pytest.raises(ValueError, match="different modules"):
+        TwoInline().init(jax.random.PRNGKey(0), jnp.ones((2, 3)))

@@ -63,3 +63,33 @@ def test_gan_save_load_roundtrip(tmp_path):
     gan2.load(d, real[:32])
     after = gan2.generate(8, seed=9)
     np.testing.assert_allclose(before, after, atol=1e-6)
+
+def test_gan_empty_epoch_raises_clearly():
+    """Regression (round-2 advisor): a dataset smaller than one batch must
+    raise a descriptive error, not a cryptic jnp.stack([]) failure.  The
+    in-RAM feed already rejects this up front; the masked-tail path (foreign
+    iterables pad + mask, GAN skips masked batches) is the one that used to
+    reach jnp.stack([])."""
+    from analytics_zoo_tpu.data.interop import from_iterator
+    gan = _gan(noise_dim=4)
+    rng = np.random.default_rng(0)
+    rows = [{"x": rng.normal(size=(2,)).astype("float32")} for _ in range(3)]
+    feed = from_iterator(lambda e: iter(rows), batch_size=32)
+    with pytest.raises(ValueError, match="no full batches"):
+        gan.fit(feed, epochs=1, batch_size=32)
+
+
+def test_gan_zero_step_sides_train_without_stack_error():
+    """d_steps=0 (or g_steps=0) pretrains one side only: full batches must
+    NOT trigger the empty-epoch guard, and the idle side records nan."""
+    import math
+    from analytics_zoo_tpu.orca.learn import GANEstimator
+    gen = nn.Sequential([nn.Dense(2)])
+    disc = nn.Sequential([nn.Dense(1)])
+    data = np.random.default_rng(0).normal(size=(64, 2)).astype("float32")
+    gan = GANEstimator(gen, disc, noise_dim=4, d_steps=0, g_steps=1)
+    hist = gan.fit(data, epochs=1, batch_size=32, verbose=False)
+    assert math.isnan(hist["d_loss"][0]) and not math.isnan(hist["g_loss"][0])
+    gan2 = GANEstimator(gen, disc, noise_dim=4, d_steps=1, g_steps=0)
+    hist2 = gan2.fit(data, epochs=1, batch_size=32, verbose=False)
+    assert math.isnan(hist2["g_loss"][0]) and not math.isnan(hist2["d_loss"][0])
