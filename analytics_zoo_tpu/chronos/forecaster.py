@@ -180,28 +180,140 @@ class TCNForecaster(_Forecaster):
     MODEL_CLS = _TCN
 
 
-# -- classical (optional CPU deps, gated like the reference) ------------------
+# -- classical (statsmodels preferred, pure-numpy fallback) -------------------
+
+class _NumpyARIMA:
+    """Pure-numpy ARIMA(p, d, q) with optional seasonal differencing —
+    Hannan–Rissanen two-stage estimation (long-AR residuals, then OLS on
+    lagged values + lagged residuals), recursive forecasting with
+    differencing inversion.  Exists so ARIMAForecaster EXECUTES in images
+    without statsmodels (reference: chronos/model/arima.py wrapped
+    pmdarima, an optional dep there too).  Seasonal AR/MA terms (P, Q > 0)
+    need a full likelihood optimizer and stay statsmodels-only."""
+
+    def __init__(self, order: Tuple[int, int, int],
+                 seasonal_order: Tuple[int, int, int, int] = (0, 0, 0, 0)):
+        self.p, self.d, self.q = order
+        P, self.D, Q, self.s = seasonal_order
+        if P or Q:
+            raise NotImplementedError(
+                "seasonal AR/MA (P, Q > 0) requires statsmodels; the "
+                "numpy backend supports seasonal differencing (D) only")
+        if self.d > 2 or self.D > 1:
+            raise NotImplementedError("numpy ARIMA supports d<=2, D<=1")
+
+    def fit(self, y: np.ndarray) -> "_NumpyARIMA":
+        y = np.asarray(y, np.float64).ravel()
+        # differencing pipeline: seasonal first, then regular; tails of
+        # every level are kept for inversion at forecast time
+        self._season_tail = None
+        w = y
+        if self.D and self.s:
+            self._season_tail = w[-self.s:].copy()
+            w = w[self.s:] - w[:-self.s]
+        self._level_tails = []
+        for _ in range(self.d):
+            self._level_tails.append(w[-1])
+            w = np.diff(w)
+        p, q = self.p, self.q
+        need = max(p, q) + p + q + 8
+        if len(w) < need:
+            raise ValueError(
+                f"series too short for ARIMA{(p, self.d, q)}: {len(w)} "
+                f"points after differencing, need >= {need}")
+        if q:
+            # stage 1: long-AR residuals
+            p_long = min(max(p + q + 3, 10), len(w) // 3)
+            e = np.zeros_like(w)
+            X = np.column_stack(
+                [np.ones(len(w) - p_long)]
+                + [w[p_long - i:len(w) - i] for i in range(1, p_long + 1)])
+            beta, *_ = np.linalg.lstsq(X, w[p_long:], rcond=None)
+            e[p_long:] = w[p_long:] - X @ beta
+        else:
+            e = np.zeros_like(w)
+        # stage 2: OLS on [1, w lags, e lags]
+        m = max(p, q)
+        cols = [np.ones(len(w) - m)]
+        cols += [w[m - i:len(w) - i] for i in range(1, p + 1)]
+        cols += [e[m - j:len(w) - j] for j in range(1, q + 1)]
+        X2 = np.column_stack(cols)
+        beta, *_ = np.linalg.lstsq(X2, w[m:], rcond=None)
+        self.const = beta[0]
+        self.phi = beta[1:1 + p]
+        self.theta = beta[1 + p:1 + p + q]
+        resid = np.zeros_like(w)
+        resid[m:] = w[m:] - X2 @ beta
+        self._w_tail = w[len(w) - max(p, 1):].copy()
+        self._e_tail = resid[len(resid) - max(q, 1):].copy()
+        return self
+
+    def forecast(self, horizon: int) -> np.ndarray:
+        p, q = self.p, self.q
+        w_hist = list(self._w_tail)
+        e_hist = list(self._e_tail)
+        out = []
+        for _ in range(horizon):
+            v = self.const
+            for i in range(1, p + 1):
+                v += self.phi[i - 1] * w_hist[-i]
+            for j in range(1, q + 1):
+                v += self.theta[j - 1] * e_hist[-j]
+            out.append(v)
+            w_hist.append(v)
+            e_hist.append(0.0)  # future shocks: expectation zero
+        f = np.asarray(out)
+        # invert regular differencing (innermost level first)
+        for last in reversed(self._level_tails):
+            f = last + np.cumsum(f)
+        # invert seasonal differencing
+        if self._season_tail is not None:
+            s = self.s
+            vals = list(self._season_tail)
+            inv = []
+            for k, fv in enumerate(f):
+                inv.append(vals[k] + fv)
+                vals.append(inv[-1])
+            f = np.asarray(inv)
+        return f
+
 
 class ARIMAForecaster:
-    """statsmodels ARIMA (reference: chronos/model/arima.py used pmdarima)."""
+    """ARIMA via statsmodels when importable, else the pure-numpy
+    Hannan–Rissanen backend (reference: chronos/model/arima.py — pmdarima,
+    likewise an optional dep there)."""
 
     def __init__(self, order: Tuple[int, int, int] = (1, 0, 0),
-                 seasonal_order: Tuple[int, int, int, int] = (0, 0, 0, 0)):
-        try:
+                 seasonal_order: Tuple[int, int, int, int] = (0, 0, 0, 0),
+                 backend: str = "auto"):
+        """``backend``: "auto" (statsmodels if importable), "statsmodels",
+        or "numpy"."""
+        if backend not in ("auto", "statsmodels", "numpy"):
+            raise ValueError(
+                f"backend must be 'auto', 'statsmodels' or 'numpy', got "
+                f"{backend!r}")
+        if backend == "auto":
+            try:
+                from statsmodels.tsa.arima.model import ARIMA  # noqa: F401
+                backend = "statsmodels"
+            except ImportError:
+                backend = "numpy"
+        if backend == "statsmodels":
             from statsmodels.tsa.arima.model import ARIMA  # noqa: F401
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "ARIMAForecaster requires statsmodels; it is an optional "
-                "CPU dependency (reference gated pmdarima the same way)"
-            ) from e
+        self.backend = backend
         self.order = order
         self.seasonal_order = seasonal_order
         self._fitted = None
 
     def fit(self, data: np.ndarray) -> "ARIMAForecaster":
-        from statsmodels.tsa.arima.model import ARIMA
-        self._fitted = ARIMA(np.asarray(data, np.float64), order=self.order,
-                             seasonal_order=self.seasonal_order).fit()
+        if self.backend == "statsmodels":
+            from statsmodels.tsa.arima.model import ARIMA
+            self._fitted = ARIMA(np.asarray(data, np.float64),
+                                 order=self.order,
+                                 seasonal_order=self.seasonal_order).fit()
+        else:
+            self._fitted = _NumpyARIMA(self.order,
+                                       self.seasonal_order).fit(data)
         return self
 
     def predict(self, horizon: int = 1) -> np.ndarray:

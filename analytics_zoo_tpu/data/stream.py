@@ -57,8 +57,11 @@ class StreamingDataFeed(FeedBase):
         rows = [self._load(i, rng=rng) for i in range(self._n - r, self._n)]
         return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
 
-    def epoch(self, mesh: Mesh, epoch_idx: int = 0
+    def epoch(self, mesh: Mesh, epoch_idx: int = 0, place: bool = True
               ) -> Iterator[Dict[str, "np.ndarray"]]:
+        """``place=False`` yields host numpy batches (no device placement):
+        the consumer owns staging, e.g. to stack K batches into one
+        infeed-chunk transfer for ``Estimator._multi_step_data``."""
         idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
 
@@ -136,7 +139,9 @@ class StreamingDataFeed(FeedBase):
         try:
             pending = None
             for step in range(steps):
-                batch = shard_batch(take(step), mesh)
+                batch = take(step)
+                if place:
+                    batch = shard_batch(batch, mesh)
                 if pending is not None:
                     yield pending                   # batch N computes while
                 pending = batch                     # N+1 already on device
@@ -145,4 +150,9 @@ class StreamingDataFeed(FeedBase):
         finally:
             queue.close()
             for t in workers:
-                t.join(timeout=5)
+                try:
+                    t.join(timeout=5)
+                except TypeError:
+                    # generator finalized during interpreter teardown:
+                    # threading internals are already torn down
+                    pass

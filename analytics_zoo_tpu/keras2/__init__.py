@@ -1,0 +1,13 @@
+"""keras2 namespace: the Keras-2-style API surface (reference:
+pyzoo/zoo/pipeline/api/keras2/ — the reference shipped a second, Keras-2-
+named layer namespace alongside the Keras-1.2 one).
+
+Here both namespaces front the SAME TPU-native module system; this package
+exists so reference scripts using ``zoo.pipeline.api.keras2`` port with an
+import-line change:
+
+    from analytics_zoo_tpu.keras2.layers import Dense, Conv2D
+    from analytics_zoo_tpu.keras2.models import Model, Sequential
+"""
+
+from . import layers, models  # noqa: F401

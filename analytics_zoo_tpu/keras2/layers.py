@@ -1,0 +1,11 @@
+"""Keras-2-named layers (reference: pyzoo/zoo/pipeline/api/keras2/layers).
+
+Every class is the TPU-native implementation from ``analytics_zoo_tpu.nn``;
+Keras-2 spellings that differ from Keras-1 (Conv2D vs Convolution2D, ...)
+are the canonical names here.
+"""
+
+from analytics_zoo_tpu.nn import *  # noqa: F401,F403
+from analytics_zoo_tpu.nn import __all__ as _nn_all
+
+__all__ = list(_nn_all)

@@ -1,0 +1,5 @@
+"""Keras-2 model containers (reference: pyzoo/zoo/pipeline/api/keras2)."""
+
+from analytics_zoo_tpu.nn import Input, Model, Sequential  # noqa: F401
+
+__all__ = ["Input", "Model", "Sequential"]

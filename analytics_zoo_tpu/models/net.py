@@ -66,6 +66,55 @@ class ForeignNet(Module):
                                       copy.deepcopy(self._variables))
 
 
+class ForeignGraphNet(Module):
+    """A converted foreign model with DAG structure (residual adds, branches,
+    merges) — the general case the chain-shaped ``ForeignNet`` can't express.
+
+    ``nodes`` execute in topological order over an environment of named
+    values; each node is either a native Module (weights baked into
+    ``init``) or a pure function of earlier values.  Reference parity:
+    TFNet/TorchNet executed arbitrary foreign graphs through JNI engines
+    (zoo/.../pipeline/api/net/TFNet.scala, Torch*.scala); here the graph is
+    converted once and jit-compiles onto the TPU like any native model."""
+
+    def __init__(self, input_names: Sequence[str], nodes: Sequence[Dict],
+                 output_name: str, variables: Params, source: str,
+                 nchw_input: bool = False):
+        super().__init__(name=None)
+        self.input_names = list(input_names)
+        self.nodes = list(nodes)
+        self.output_name = output_name
+        self._variables = variables
+        self.source = source
+        self.nchw_input = nchw_input
+
+    def forward(self, scope: Scope, *xs: jax.Array) -> jax.Array:
+        if len(xs) != len(self.input_names):
+            raise ValueError(
+                f"model takes {len(self.input_names)} inputs, got {len(xs)}")
+        env: Dict[str, jax.Array] = {}
+        for name, x in zip(self.input_names, xs):
+            if self.nchw_input and x.ndim == 4:
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            env[name] = x
+        for node in self.nodes:
+            args = [env[a] if ref else a for ref, a in node["args"]]
+            if node["module"] is not None:
+                env[node["name"]] = scope.child(node["module"], *args,
+                                                name=node["name"])
+            else:
+                env[node["name"]] = node["fn"](*args)
+        out = env[self.output_name]
+        if self.nchw_input and out.ndim == 4:
+            out = jnp.transpose(out, (0, 3, 1, 2))
+        return out
+
+    def init(self, rng: jax.Array, *args: Any, **kwargs: Any) -> Params:
+        """The imported weights, not a random init."""
+        return jax.tree_util.tree_map(jnp.asarray,
+                                      copy.deepcopy(self._variables))
+
+
 class Net:
     """Loader namespace (reference: ``Net.load_tf/load_torch/load_bigdl``)."""
 
@@ -87,7 +136,12 @@ class Net:
             except RuntimeError:
                 module = torch.load(module, weights_only=False)
         module = module.eval()
-        leaves = _torch_leaves(module)
+        try:
+            leaves = _torch_leaves(module)
+        except NotImplementedError:
+            # not a Sequential chain: convert the full DAG via torch.fx
+            # (raises itself for TorchScript, which cannot be fx-traced)
+            return _load_torch_fx(module, example_input)
         x = torch.as_tensor(np.asarray(example_input))
         shapes = _torch_trace_shapes(module, leaves, x)
         nchw = x.ndim == 4
@@ -123,6 +177,16 @@ class Net:
                           source="torch", nchw_input=nchw)
 
     @staticmethod
+    def load_torch_graph(module: Any, example_input: Any) -> ForeignGraphNet:
+        """Convert a graph-structured ``torch.nn.Module`` (residual adds,
+        branches, concats — e.g. torchvision-style ResNets) via torch.fx
+        symbolic tracing.  ``load_torch`` falls back to this automatically
+        when the module is not a Sequential chain; TorchScript modules
+        cannot be fx-traced and must convert via the chain path or the
+        escape hatch."""
+        return _load_torch_fx(module, example_input)
+
+    @staticmethod
     def torch_params_to_tree(module: Any) -> Dict[str, np.ndarray]:
         """Escape hatch: every parameter and buffer as {dotted_name: array}."""
         out = {}
@@ -145,13 +209,8 @@ class Net:
         layers = [l for l in model.layers
                   if type(l).__name__ != "InputLayer"]
         if not isinstance(model, tf.keras.Sequential):
-            # a functional graph can branch/merge in ways model.layers
-            # order does not represent — inbound-node counting cannot
-            # detect fan-out reliably, so only Sequential converts
-            raise NotImplementedError(
-                "only tf.keras.Sequential models convert automatically "
-                "(functional graphs may branch); see the escape hatch in "
-                "analytics_zoo_tpu.models.net's docstring")
+            # functional graph (branches/merges): walk the config DAG
+            return _load_keras_functional(model)
         stages: List[Tuple[str, Module]] = []
         params: Dict[str, Any] = {}
         state: Dict[str, Any] = {}
@@ -250,23 +309,21 @@ def _t_conv2d(m, in_shape, prev_flat):
     stride = tuple(m.stride)
     pad = m.padding
     k = tuple(m.kernel_size)
-    if isinstance(pad, str):        # torch accepts 'same'/'valid' directly
-        pad = ((0, 0) if pad == "valid"
-               else (k[0] // 2, k[1] // 2) if stride == (1, 1)
-               else pad)            # 'same' at stride>1: fall through/raise
-    elif isinstance(pad, int):
-        pad = (pad, pad)
+    if isinstance(pad, str):        # torch accepts 'same'/'valid' strings
+        if pad == "valid":
+            padding: Any = "valid"
+        elif pad == "same" and stride == (1, 1):
+            padding = "same"
+        else:
+            raise NotImplementedError(
+                f"torch Conv2d padding={pad!r} stride={stride} has no "
+                "exact equivalent; use the escape hatch")
     else:
-        pad = tuple(pad)
-    if pad == (0, 0):
-        padding = "valid"
-    elif (stride == (1, 1) and k[0] % 2 == 1 and k[1] % 2 == 1
-          and pad == (k[0] // 2, k[1] // 2)):
-        padding = "same"   # exact equivalence only at stride 1 / odd kernel
-    else:
-        raise NotImplementedError(
-            f"torch Conv2d padding={pad} stride={stride} has no exact "
-            "same/valid equivalent; use the escape hatch")
+        # numeric torch padding: exact via explicit (lo, hi) pairs —
+        # torch pads symmetrically, which differs from XLA SAME at
+        # stride > 1, so never approximate with "same" here
+        pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+        padding = ((pad[0], pad[0]), (pad[1], pad[1]))
     p = {"kernel": _np(m.weight).transpose(2, 3, 1, 0)}  # OIHW → HWIO
     if m.bias is not None:
         p["bias"] = _np(m.bias)
@@ -324,14 +381,21 @@ def _t_pool(kind):
         k = (k, k) if isinstance(k, int) else tuple(k)
         s = m.stride or k
         s = (s, s) if isinstance(s, int) else tuple(s)
+        if getattr(m, "ceil_mode", False):
+            raise NotImplementedError(
+                "torch pooling with ceil_mode=True has no exact equivalent "
+                "here; use the escape hatch")
         pad = m.padding
         pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
-        if pad != (0, 0):
+        if (kind == "avg" and pad != (0, 0)
+                and not getattr(m, "count_include_pad", True)):
             raise NotImplementedError(
-                "torch pooling with padding has no exact equivalent here; "
-                "use the escape hatch")
+                "AvgPool2d(count_include_pad=False) with padding has no "
+                "exact equivalent here; use the escape hatch")
+        padding: Any = ("valid" if pad == (0, 0)
+                        else ((pad[0], pad[0]), (pad[1], pad[1])))
         cls = nn.MaxPooling2D if kind == "max" else nn.AveragePooling2D
-        return cls(k, s, padding="valid"), {}, {}
+        return cls(k, s, padding=padding), {}, {}
     return conv
 
 
@@ -384,10 +448,483 @@ _TORCH_CONVERTERS: Dict[str, Callable] = {
 }
 
 
+# -- torch fx graph conversion -------------------------------------------------
+
+# elementwise torch module kinds: safe to carry a pending Flatten->Linear
+# kernel-reorder through (order-preserving on the flattened axis, no
+# per-position parameters)
+_ORDER_PRESERVING_KINDS = frozenset({
+    "ReLU", "GELU", "Tanh", "Sigmoid", "Softmax", "Dropout", "Identity",
+    "LeakyReLU", "ELU", "SiLU", "Hardswish",
+})
+
+# kinds with PER-POSITION parameters: applying them to an NCHW-flattened
+# value would need their own param reorder, which is not implemented
+_POSITIONAL_PARAM_KINDS = frozenset({"LayerNorm", "BatchNorm1d"})
+
+def _load_torch_fx(module: Any, example_input: Any) -> ForeignGraphNet:
+    """fx-trace a torch module and convert its DAG to a ForeignGraphNet.
+
+    Layout invariant: every 4-D value in the converted graph is NHWC (the
+    TPU-native layout); the net transposes at its input/output boundary.
+    Shape metadata from torch's ShapeProp is NCHW and is used to (a) detect
+    4-D values whose axis arguments need remapping (cat/softmax/mean) and
+    (b) reorder Linear kernels that consume a flatten of feature maps."""
+    import torch
+    from torch import fx
+    from torch.fx.passes.shape_prop import ShapeProp
+
+    if isinstance(module, torch.jit.ScriptModule):
+        raise NotImplementedError(
+            "TorchScript modules cannot be fx-traced; only Sequential "
+            "TorchScript chains convert (see the escape hatch in "
+            "analytics_zoo_tpu.models.net)")
+    module = module.eval()
+    x = torch.as_tensor(np.asarray(example_input))
+    try:
+        traced = fx.symbolic_trace(module)
+        ShapeProp(traced).propagate(x)
+    except Exception as e:
+        raise NotImplementedError(
+            f"module could not be fx-traced for graph conversion ({e}); "
+            "see the escape hatch in analytics_zoo_tpu.models.net's "
+            "docstring") from e
+
+    def shp(n) -> Optional[Tuple[int, ...]]:
+        tm = n.meta.get("tensor_meta") if isinstance(n, fx.Node) else None
+        return tuple(tm.shape) if tm is not None else None
+
+    nchw = x.ndim == 4
+    input_names: List[str] = []
+    nodes: List[Dict] = []
+    params: Dict[str, Any] = {}
+    state: Dict[str, Any] = {}
+    output_name: Optional[str] = None
+    # env-name aliasing for identity nodes (Dropout-eval, .contiguous())
+    alias: Dict[str, str] = {}
+    # env name -> NCHW shape its value was flattened from (kernel reorder)
+    flat_origin: Dict[str, Tuple[int, ...]] = {}
+
+    def res(n) -> str:
+        name = n.name
+        while name in alias:
+            name = alias[name]
+        return name
+
+    def refargs(args) -> List[Tuple[bool, Any]]:
+        out = []
+        for a in args:
+            if isinstance(a, fx.Node):
+                out.append((True, res(a)))
+            else:
+                out.append((False, a))
+        return out
+
+    for n in traced.graph.nodes:
+        if n.op == "placeholder":
+            input_names.append(n.name)
+            continue
+        if n.op == "output":
+            arg = n.args[0]
+            if not isinstance(arg, fx.Node):
+                raise NotImplementedError(
+                    "only single-tensor outputs convert; see the escape "
+                    "hatch in analytics_zoo_tpu.models.net")
+            output_name = res(arg)
+            continue
+        if n.op == "call_module":
+            leaf = traced.get_submodule(n.target)
+            kind = _torch_kind(leaf)
+            conv = _TORCH_CONVERTERS.get(kind)
+            if conv is None:
+                raise NotImplementedError(
+                    f"torch layer {kind} is not in the supported conversion "
+                    f"set {sorted(_TORCH_CONVERTERS)}; see the escape hatch "
+                    "in analytics_zoo_tpu.models.net's docstring")
+            in_shape = shp(n.args[0]) or ()
+            mod, p, s = conv(leaf, in_shape, flat_origin.get(res(n.args[0])))
+            if kind == "Flatten" and len(in_shape) == 4:
+                flat_origin[n.name] = in_shape
+            elif kind in _ORDER_PRESERVING_KINDS:
+                # elementwise module between Flatten and Linear: the
+                # pending kernel-reorder flows through
+                src = res(n.args[0])
+                if src in flat_origin:
+                    flat_origin[n.name] = flat_origin[src]
+            elif (kind in _POSITIONAL_PARAM_KINDS
+                  and res(n.args[0]) in flat_origin):
+                raise NotImplementedError(
+                    f"{kind} applied to a flattened NCHW feature map would "
+                    "need its per-position parameters reordered, which is "
+                    "unsupported; use the escape hatch")
+            if mod is None:
+                alias[n.name] = res(n.args[0])
+                continue
+            nodes.append({"name": n.name, "module": mod, "fn": None,
+                          "args": refargs(n.args)})
+            if p:
+                params[n.name] = p
+            if s:
+                state[n.name] = s
+            continue
+        if n.op in ("call_function", "call_method"):
+            handled = _fx_function(n, shp, res, refargs, alias, flat_origin)
+            if handled is not None:
+                nodes.append(handled)
+            continue
+        if n.op == "get_attr":
+            # a constant tensor/parameter referenced directly in forward;
+            # 4-D constants are NCHW in torch but every 4-D value in the
+            # converted graph is NHWC — transpose at the boundary
+            t = traced
+            for part in n.target.split("."):
+                t = getattr(t, part)
+            val = np.asarray(t.detach().cpu().numpy())
+            if val.ndim == 4:
+                val = val.transpose(0, 2, 3, 1)
+            nodes.append({"name": n.name, "module": None,
+                          "fn": (lambda v=val: jnp.asarray(v)), "args": []})
+            continue
+        raise NotImplementedError(f"fx op {n.op} is unsupported")
+
+    if output_name is None:
+        raise NotImplementedError("traced graph has no output node")
+    return ForeignGraphNet(input_names, nodes, output_name,
+                           {"params": params, "state": state},
+                           source="torch", nchw_input=nchw)
+
+
+def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
+    """Convert one fx call_function/call_method node; returns a graph node,
+    records an alias (identity ops), or raises for unsupported ops."""
+    import operator as op
+    import torch
+    import torch.nn.functional as F
+    from torch import fx
+
+    target = n.target
+    tname = target if isinstance(target, str) else getattr(
+        target, "__name__", str(target))
+    is4d = (shp(n.args[0]) is not None and len(shp(n.args[0])) == 4
+            if n.args and isinstance(n.args[0], fx.Node) else False)
+
+    def node(fn, args):
+        return {"name": n.name, "module": None, "fn": fn,
+                "args": refargs(args)}
+
+    def propagate_flat():
+        # order-preserving op: a pending flatten-reorder flows through.
+        # The first NODE operand carries it (a constant operand, e.g. the
+        # 1.0 in "1.0 - x", has no env name).
+        for a in n.args:
+            if isinstance(a, fx.Node):
+                src = res(a)
+                if src in flat_origin:
+                    flat_origin[n.name] = flat_origin[src]
+                return
+
+    # elementwise arithmetic (operator.*, torch.*, tensor methods)
+    binops = {
+        ("add", "iadd", "add_"): lambda a, b: a + b,
+        ("sub", "isub", "sub_"): lambda a, b: a - b,
+        ("rsub",): lambda a, b: b - a,  # torch.rsub(x, o) == o - x
+        ("mul", "imul", "mul_"): lambda a, b: a * b,
+        ("truediv", "div", "div_"): lambda a, b: a / b,
+    }
+    for names, fn in binops.items():
+        if tname in names:
+            propagate_flat()
+            return node(fn, n.args[:2])
+
+    unary = {
+        "relu": jax.nn.relu, "relu_": jax.nn.relu, "tanh": jnp.tanh,
+        "sigmoid": jax.nn.sigmoid, "silu": jax.nn.silu,
+        "hardswish": jax.nn.hard_swish, "abs": jnp.abs, "exp": jnp.exp,
+    }
+    if tname in unary:
+        propagate_flat()
+        return node(unary[tname], n.args[:1])
+
+    if tname == "gelu":
+        approx = n.kwargs.get("approximate", "none") != "none"
+        return node(lambda v, a=approx: jax.nn.gelu(v, approximate=a),
+                    n.args[:1])
+
+    if tname in ("contiguous", "clone", "detach", "dropout"):
+        # dropout reaches here only as F.dropout(training=False) under
+        # .eval(); trace-time constant False makes it identity
+        if tname == "dropout" and n.kwargs.get("training", False):
+            raise NotImplementedError(
+                "F.dropout(training=True) inside forward has no converted "
+                "equivalent; use nn.Dropout modules instead")
+        alias[n.name] = res(n.args[0])
+        # identity preserves any pending flatten-reorder
+        src = res(n.args[0])
+        if src in flat_origin:
+            flat_origin[n.name] = flat_origin[src]
+        return None
+
+    if tname == "flatten":
+        start = (n.args[1] if len(n.args) > 1
+                 else n.kwargs.get("start_dim", 0))
+        if start != 1:
+            raise NotImplementedError(
+                "only flatten(start_dim=1) converts; see the escape hatch")
+        in_shape = shp(n.args[0])
+        if in_shape is not None and len(in_shape) == 4:
+            flat_origin[n.name] = in_shape
+        return node(lambda v: v.reshape(v.shape[0], -1), n.args[:1])
+
+    if tname in ("view", "reshape"):
+        tail = n.args[1:]
+        if len(tail) == 1 and isinstance(tail[0], (tuple, list)):
+            tail = tuple(tail[0])
+        # x.view(B, -1) / x.view(x.size(0), -1): first arg may be an fx
+        # node (the batch size); only the trailing -1 matters
+        if len(tail) == 2 and tail[1] == -1:
+            in_shape = shp(n.args[0])
+            if in_shape is not None and len(in_shape) == 4:
+                flat_origin[n.name] = in_shape
+            return node(lambda v: v.reshape(v.shape[0], -1), n.args[:1])
+        raise NotImplementedError(
+            f"{tname}{tuple(tail)} is unsupported (only (B, -1) flattens "
+            "convert); see the escape hatch")
+
+    if tname in ("cat", "concat"):
+        tensors = n.args[0]
+        dim = (n.args[1] if len(n.args) > 1 else n.kwargs.get("dim", 0))
+        shapes = [shp(t) for t in tensors]
+        if all(s is not None and len(s) == 4 for s in shapes):
+            if dim in (1, -3):
+                axis = -1          # channel concat in NHWC
+            elif dim == 0:
+                axis = 0
+            else:
+                raise NotImplementedError(
+                    f"cat over NCHW dim {dim} has no NHWC mapping here")
+        else:
+            axis = dim
+        return {"name": n.name, "module": None,
+                "fn": (lambda *vs, a=axis: jnp.concatenate(vs, axis=a)),
+                "args": [(True, res(t)) for t in tensors]}
+
+    if tname == "softmax":
+        dim = (n.args[1] if len(n.args) > 1 else n.kwargs.get("dim", -1))
+        if is4d and dim in (1, -3):
+            dim = -1
+        return node(lambda v, d=dim: jax.nn.softmax(v, axis=d), n.args[:1])
+
+    if tname == "mean":
+        dims = (n.args[1] if len(n.args) > 1 else n.kwargs.get("dim"))
+        keep = (n.args[2] if len(n.args) > 2
+                else n.kwargs.get("keepdim", False))
+        if dims is None:
+            return node(lambda v: v.mean(), n.args[:1])
+        dims = [dims] if isinstance(dims, int) else list(dims)
+        if is4d:
+            if sorted(d % 4 for d in dims) == [2, 3]:
+                axes = (1, 2)      # spatial mean in NHWC
+            else:
+                raise NotImplementedError(
+                    f"mean over NCHW dims {dims} has no NHWC mapping here")
+        else:
+            axes = tuple(dims)
+        return node(lambda v, a=axes, k=keep: v.mean(axis=a, keepdims=k),
+                    n.args[:1])
+
+    if tname == "adaptive_avg_pool2d":
+        out = n.args[1] if len(n.args) > 1 else n.kwargs.get("output_size")
+        out = (out, out) if isinstance(out, int) else tuple(out)
+        if out != (1, 1):
+            raise NotImplementedError(
+                "adaptive_avg_pool2d converts only for output_size=1")
+        return node(lambda v: v.mean(axis=(1, 2), keepdims=True),
+                    n.args[:1])
+
+    if tname in ("max_pool2d", "avg_pool2d"):
+        k = n.args[1] if len(n.args) > 1 else n.kwargs.get("kernel_size")
+        s = (n.args[2] if len(n.args) > 2
+             else n.kwargs.get("stride")) or k
+        # F.max_pool2d(x, k, s, pad, dilation, ceil_mode); avg_pool2d has
+        # no dilation and ceil_mode at position 4
+        ceil_pos = 5 if tname == "max_pool2d" else 4
+        if (n.kwargs.get("ceil_mode", False)
+                or (len(n.args) > ceil_pos and n.args[ceil_pos])):
+            raise NotImplementedError(
+                "functional pooling with ceil_mode=True has no exact "
+                "equivalent here; use the escape hatch")
+        dil = (n.args[4] if (tname == "max_pool2d" and len(n.args) > 4)
+               else n.kwargs.get("dilation", 1))
+        if dil not in (1, (1, 1)):
+            raise NotImplementedError(
+                "functional max_pool2d with dilation has no equivalent "
+                "here; use the escape hatch")
+        pad = (n.args[3] if len(n.args) > 3 else n.kwargs.get("padding", 0))
+        pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+        if (tname == "avg_pool2d" and pad != (0, 0)
+                and not (n.args[5] if len(n.args) > 5
+                         else n.kwargs.get("count_include_pad", True))):
+            raise NotImplementedError(
+                "avg_pool2d(count_include_pad=False) with padding has no "
+                "exact equivalent here; use the escape hatch")
+        padding: Any = ("valid" if pad == (0, 0)
+                        else ((pad[0], pad[0]), (pad[1], pad[1])))
+        k = (k, k) if isinstance(k, int) else tuple(k)
+        s = (s, s) if isinstance(s, int) else tuple(s)
+        cls = (nn.MaxPooling2D if tname == "max_pool2d"
+               else nn.AveragePooling2D)
+        return {"name": n.name, "module": cls(k, s, padding=padding),
+                "fn": None, "args": refargs(n.args[:1])}
+
+    raise NotImplementedError(
+        f"torch op {tname!r} is not in the supported conversion set; see "
+        "the escape hatch in analytics_zoo_tpu.models.net's docstring")
+
+
 # -- keras helpers -------------------------------------------------------------
 
 def _k_weights(layer) -> List[np.ndarray]:
     return [np.asarray(w) for w in layer.get_weights()]
+
+
+# merge layers (functional graphs only): pure functions over the inbound list
+_K_MERGES: Dict[str, Callable] = {
+    "Add": lambda cfg: (lambda *vs: sum(vs[1:], vs[0])),
+    "Subtract": lambda cfg: (lambda a, b: a - b),
+    "Multiply": lambda cfg: (lambda *vs: _reduce(jnp.multiply, vs)),
+    "Average": lambda cfg: (lambda *vs: sum(vs[1:], vs[0]) / len(vs)),
+    "Maximum": lambda cfg: (lambda *vs: _reduce(jnp.maximum, vs)),
+    "Minimum": lambda cfg: (lambda *vs: _reduce(jnp.minimum, vs)),
+    "Concatenate": lambda cfg: (
+        lambda *vs, a=cfg.get("axis", -1): jnp.concatenate(vs, axis=a)),
+}
+
+
+def _reduce(fn, vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = fn(out, v)
+    return out
+
+
+def _keras_inbound(layer_cfg) -> List[str]:
+    """Producer layer names feeding one layer, from its serialized inbound
+    nodes.  Handles both the Keras 3 ``__keras_tensor__`` format and the
+    legacy Keras 2 nested-list format."""
+    nodes = layer_cfg.get("inbound_nodes", [])
+    if len(nodes) != 1:
+        raise NotImplementedError(
+            f"layer {layer_cfg.get('name')!r} is applied {len(nodes)} times "
+            "(shared layers are unsupported in conversion); see the escape "
+            "hatch in analytics_zoo_tpu.models.net")
+    names: List[str] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if obj.get("class_name") == "__keras_tensor__":
+                hist = obj["config"]["keras_history"]
+                if hist[1] != 0:
+                    raise NotImplementedError(
+                        "shared-layer tensors are unsupported in conversion")
+                names.append(hist[0])
+                return
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, (list, tuple)):
+            # keras-2 format: ["layer_name", node_idx, tensor_idx, {...}]
+            if (len(obj) >= 3 and isinstance(obj[0], str)
+                    and isinstance(obj[1], int) and isinstance(obj[2], int)):
+                names.append(obj[0])
+                return
+            for v in obj:
+                walk(v)
+
+    walk(nodes)
+    return names
+
+
+def _load_keras_functional(model) -> ForeignGraphNet:
+    """Convert a functional tf.keras model (skip connections, merges) by
+    walking its config DAG.  Keras is channels-last already, so no layout
+    remapping is needed — node layers use the same converter table as the
+    Sequential path, merge layers become pure functions."""
+    cfg = model.get_config()
+    by_name = {l.name: l for l in model.layers}
+    out_spec = cfg.get("output_layers")
+    # keras 3 flattens a single output to [name, node, tensor]; keras 2
+    # keeps a list of such triples
+    if (isinstance(out_spec, (list, tuple)) and len(out_spec) == 3
+            and isinstance(out_spec[0], str)):
+        out_spec = [out_spec]
+    if not out_spec or len(out_spec) != 1:
+        raise NotImplementedError(
+            "multi-output functional models are unsupported in conversion; "
+            "see the escape hatch in analytics_zoo_tpu.models.net")
+    output_name = out_spec[0][0]
+
+    input_names: List[str] = []
+    nodes: List[Dict] = []
+    params: Dict[str, Any] = {}
+    state: Dict[str, Any] = {}
+    alias: Dict[str, str] = {}
+
+    def res(name: str) -> str:
+        while name in alias:
+            name = alias[name]
+        return name
+
+    # topological order over the config (config order is build order, but
+    # sort explicitly so partial configs still convert)
+    layer_cfgs = {l["name"]: l for l in cfg["layers"]}
+    done: set = set()
+    order: List[str] = []
+
+    def visit(name: str, stack=()):
+        if name in done:
+            return
+        if name in stack:
+            raise ValueError(f"cycle at layer {name!r}")
+        lc = layer_cfgs[name]
+        if lc["class_name"] != "InputLayer":
+            for dep in _keras_inbound(lc):
+                visit(dep, stack + (name,))
+        done.add(name)
+        order.append(name)
+
+    for l in cfg["layers"]:
+        visit(l["name"])
+
+    for name in order:
+        lc = layer_cfgs[name]
+        kind = lc["class_name"]
+        if kind == "InputLayer":
+            input_names.append(name)
+            continue
+        inbound = [res(p) for p in _keras_inbound(lc)]
+        if kind in _K_MERGES:
+            fn = _K_MERGES[kind](lc.get("config", {}))
+            nodes.append({"name": name, "module": None, "fn": fn,
+                          "args": [(True, p) for p in inbound]})
+            continue
+        conv = _TF_CONVERTERS.get(kind)
+        if conv is None:
+            raise NotImplementedError(
+                f"keras layer {kind} is not in the supported conversion "
+                f"set {sorted(_TF_CONVERTERS) + sorted(_K_MERGES)}; see "
+                "the escape hatch in analytics_zoo_tpu.models.net")
+        mod, p, s = conv(by_name[name])
+        if mod is None:
+            alias[name] = inbound[0]
+            continue
+        nodes.append({"name": name, "module": mod, "fn": None,
+                      "args": [(True, p) for p in inbound]})
+        if p:
+            params[name] = p
+        if s:
+            state[name] = s
+
+    return ForeignGraphNet(input_names, nodes, res(output_name),
+                           {"params": params, "state": state}, source="tf")
 
 
 def _k_dense(layer):

@@ -23,9 +23,25 @@ from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
                            SpatialDropout3D, Subtract, ThresholdedReLU,
                            UpSampling1D, UpSampling2D, UpSampling3D,
                            ZeroPadding1D, ZeroPadding3D)
+from .layers_zoo import (ActivityRegularization, AddConstant, AlphaDropout,
+                         Conv1DTranspose, Conv3DTranspose, ConvLSTM2D, Cos,
+                         Exp, HardShrink, Identity, LocallyConnected2D, Log,
+                         LRN2D, MulConstant, Negative, Power, Scale,
+                         SeparableConv1D, Softmax, SoftShrink, Sqrt, Square,
+                         Threshold)
 from .functional import Input, Model, SymbolicTensor
 from .module import Module, Scope, param_count
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
+
+# keras-1 naming aliases (reference: zoo keras-1.2 class names) so ported
+# scripts keep their spellings
+Convolution1D = Conv1D
+Convolution2D = Conv2D
+Convolution3D = Conv3D
+Deconvolution2D = Conv2DTranspose
+Deconvolution3D = Conv3DTranspose
+AtrousConvolution1D = Conv1D   # dilation= covers the atrous variants
+AtrousConvolution2D = Conv2D
 
 __all__ = [
     "activations", "initializers", "losses", "metrics",
@@ -52,4 +68,13 @@ __all__ = [
     "Input", "Model", "SymbolicTensor",
     "Remat",
     "Cropping3D", "SReLU", "Select", "Narrow", "Squeeze",
+    # layer-zoo backfill (layers_zoo)
+    "ConvLSTM2D", "LocallyConnected2D", "Conv3DTranspose", "Conv1DTranspose",
+    "SeparableConv1D", "AlphaDropout", "Softmax", "ActivityRegularization",
+    "LRN2D", "Cos", "Identity", "Exp", "Log", "Sqrt", "Square", "Power",
+    "Negative", "AddConstant", "MulConstant", "Scale", "Threshold",
+    "HardShrink", "SoftShrink",
+    # keras-1 naming aliases
+    "Convolution1D", "Convolution2D", "Convolution3D", "Deconvolution2D",
+    "Deconvolution3D", "AtrousConvolution1D", "AtrousConvolution2D",
 ]

@@ -32,6 +32,19 @@ def _cast_for_compute(x: jax.Array, dtype: Optional[Any]) -> jax.Array:
     return x.astype(dtype) if dtype is not None else x
 
 
+def _norm_padding(p: Any) -> Any:
+    """'same'/'valid' → upper string; int / (h, w) / ((lo,hi),(lo,hi)) →
+    explicit per-dimension pad pairs (torch-style numeric padding)."""
+    if isinstance(p, str):
+        return p.upper()
+    if isinstance(p, int):
+        return ((p, p), (p, p))
+    p = tuple(p)
+    if all(isinstance(e, int) for e in p):
+        return tuple((e, e) for e in p)
+    return tuple((int(a), int(b)) for a, b in p)
+
+
 class Dense(Module):
     """Fully connected layer (reference: keras/layers Dense)."""
 
@@ -130,7 +143,7 @@ class Conv2D(Module):
 
     def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
                  strides: Union[int, Sequence[int]] = 1,
-                 padding: str = "same", activation: Any = None,
+                 padding: Any = "same", activation: Any = None,
                  use_bias: bool = True, kernel_init: Any = "he_normal",
                  dilation: Union[int, Sequence[int]] = 1,
                  groups: int = 1, dtype: Optional[Any] = None,
@@ -139,7 +152,9 @@ class Conv2D(Module):
         self.filters = filters
         self.kernel_size = _pair(kernel_size)
         self.strides = _pair(strides)
-        self.padding = padding.upper()
+        # "same"/"valid", or torch-style numeric padding (int / pair /
+        # explicit (lo, hi) pairs) for exact foreign-model parity
+        self.padding = _norm_padding(padding)
         self.activation = activations.get(activation)
         self.use_bias = use_bias
         self.kernel_init = initializers.get(kernel_init)
@@ -153,13 +168,15 @@ class Conv2D(Module):
         w = scope.param("kernel", self.kernel_init,
                         (kh, kw, in_ch // self.groups, self.filters))
         xc = _cast_for_compute(x, self.dtype)
+        # No preferred_element_type: the conv vjp in this JAX version rejects
+        # mixed (bf16 cotangent, f32-preferred) operands, and the TPU MXU
+        # accumulates bf16 convs in f32 natively anyway.
         y = jax.lax.conv_general_dilated(
             xc, _cast_for_compute(w, self.dtype).astype(xc.dtype),
             window_strides=self.strides, padding=self.padding,
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.groups,
-            preferred_element_type=jnp.float32)
+            feature_group_count=self.groups)
         y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"), (self.filters,))
@@ -183,14 +200,19 @@ class Conv1D(Module):
 
 
 def _pool(x: jax.Array, kind: str, window: Tuple[int, int],
-          strides: Tuple[int, int], padding: str) -> jax.Array:
+          strides: Tuple[int, int], padding: Any) -> jax.Array:
     dims = (1, window[0], window[1], 1)
     strd = (1, strides[0], strides[1], 1)
+    explicit = not isinstance(padding, str)
+    if explicit:  # per-spatial-dim (lo, hi) pairs -> full 4-dim spec
+        padding = ((0, 0),) + tuple(padding) + ((0, 0),)
     if kind == "max":
         return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strd,
                                      padding)
     s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, padding)
-    if padding == "VALID":
+    if padding == "VALID" or explicit:
+        # explicit numeric padding follows torch AvgPool2d semantics
+        # (count_include_pad=True): pads are zeros AND count in the divisor
         return s / (window[0] * window[1])
     ones = jnp.ones(x.shape[:1] + x.shape[1:3] + (1,), x.dtype)
     cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd, padding)
@@ -200,11 +222,11 @@ def _pool(x: jax.Array, kind: str, window: Tuple[int, int],
 class MaxPooling2D(Module):
     def __init__(self, pool_size: Union[int, Sequence[int]] = 2,
                  strides: Optional[Union[int, Sequence[int]]] = None,
-                 padding: str = "valid", name: Optional[str] = None):
+                 padding: Any = "valid", name: Optional[str] = None):
         super().__init__(name)
         self.pool_size = _pair(pool_size)
         self.strides = _pair(strides) if strides is not None else self.pool_size
-        self.padding = padding.upper()
+        self.padding = _norm_padding(padding)
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         return _pool(x, "max", self.pool_size, self.strides, self.padding)
@@ -213,11 +235,11 @@ class MaxPooling2D(Module):
 class AveragePooling2D(Module):
     def __init__(self, pool_size: Union[int, Sequence[int]] = 2,
                  strides: Optional[Union[int, Sequence[int]]] = None,
-                 padding: str = "valid", name: Optional[str] = None):
+                 padding: Any = "valid", name: Optional[str] = None):
         super().__init__(name)
         self.pool_size = _pair(pool_size)
         self.strides = _pair(strides) if strides is not None else self.pool_size
-        self.padding = padding.upper()
+        self.padding = _norm_padding(padding)
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         return _pool(x, "avg", self.pool_size, self.strides, self.padding)

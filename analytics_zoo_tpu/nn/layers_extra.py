@@ -59,8 +59,7 @@ class Conv3D(Module):
         y = jax.lax.conv_general_dilated(
             x, w.astype(x.dtype), window_strides=self.strides,
             padding=self.padding,
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")).astype(x.dtype)
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"),
                             (self.filters,))
@@ -89,11 +88,11 @@ class Conv2DTranspose(Module):
         kh, kw = self.kernel_size
         w = scope.param("kernel", self.kernel_init,
                         (kh, kw, x.shape[-1], self.filters))
-        y = jax.lax.conv_transpose(
-            x, w.astype(x.dtype), strides=self.strides,
-            padding=self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32).astype(x.dtype)
+        # exact keras Conv2DTranspose semantics (gradient-of-conv); lax's
+        # own conv_transpose distributes SAME padding differently
+        from .layers_zoo import _deconv
+        y = _deconv(x, w, self.strides, self.padding,
+                    ("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"),
                             (self.filters,))
@@ -129,8 +128,7 @@ class DepthwiseConv2D(Module):
             x, w.astype(x.dtype), window_strides=self.strides,
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=ch,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            feature_group_count=ch).astype(x.dtype)
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"), (out_ch,))
             y = y + b.astype(y.dtype)

@@ -1,0 +1,451 @@
+"""Keras-1.2 / BigDL layer-zoo backfill: the breadth tail of the reference
+layer set (VERDICT r2 missing #3).
+
+Reference (SURVEY.md §2.3): zoo/.../pipeline/api/keras/layers/ plus the
+BigDL tensor-op layers its py4j mirrors exposed, and the keras2 namespace
+(pyzoo/zoo/pipeline/api/keras2/).  layers.py + layers_extra.py carry the
+core ~75; this module adds the remaining commonly-ported classes:
+ConvLSTM2D, LocallyConnected2D, transpose-conv variants, separable-1D,
+keras-2 extras (AlphaDropout, Softmax), LRN, the "cos" merge mode, and the
+BigDL element-op layers (Exp/Log/Power/Scale/...).  All TPU-native: NHWC /
+NDHWC layouts, pure functions of variables, lax.scan for recurrence,
+jit/shard_map-composable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from . import activations, initializers
+from .layers import _norm_padding, _pair
+from .layers_extra import _triple
+from .module import Module, Scope
+
+
+# -- recurrent convolution -----------------------------------------------------
+
+class ConvLSTM2D(Module):
+    """Convolutional LSTM (reference: ConvLSTM2D — zoo keras layers; BigdDL
+    ConvLSTM2D/3D).  Input [B, T, H, W, C], NHWC frames; gates are convs of
+    the frame and the hidden state, recurrence via lax.scan (compiler-
+    friendly: one compiled step body, no Python loop)."""
+
+    def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: Any = "same",
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 kernel_init: Any = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = _norm_padding(padding)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.kernel_init = initializers.get(kernel_init)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        if x.ndim != 5:
+            raise ValueError(f"ConvLSTM2D wants [B,T,H,W,C], got {x.shape}")
+        b, t, h, w, c = x.shape
+        kh, kw = self.kernel_size
+        f = self.filters
+        wx = scope.param("kernel", self.kernel_init, (kh, kw, c, 4 * f))
+        wh = scope.param("recurrent_kernel", self.kernel_init,
+                         (kh, kw, f, 4 * f))
+        bias = scope.param("bias", initializers.get("zeros"), (4 * f,))
+
+        def conv(inp, kern, strides):
+            return jax.lax.conv_general_dilated(
+                inp, kern, window_strides=strides, padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        # spatial dims after the (possibly strided) input conv; the
+        # recurrent conv is stride-1 SAME over that grid
+        oh = jax.eval_shape(lambda a: conv(a, wx, self.strides),
+                            jax.ShapeDtypeStruct((b, h, w, c), x.dtype)
+                            ).shape[1:3]
+
+        def step(carry, xt):
+            hid, cell = carry
+            z = (conv(xt, wx, self.strides)
+                 + conv(hid, wh, (1, 1)) + bias)
+            i, fg, g, o = jnp.split(z, 4, axis=-1)
+            cell = jax.nn.sigmoid(fg) * cell + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hid = jax.nn.sigmoid(o) * jnp.tanh(cell)
+            return (hid, cell), hid
+
+        seq = jnp.moveaxis(x, 1, 0)  # [T, B, H, W, C]
+        init = (jnp.zeros((b,) + oh + (f,), x.dtype),
+                jnp.zeros((b,) + oh + (f,), x.dtype))
+        (hid, _), outs = jax.lax.scan(step, init, seq,
+                                      reverse=self.go_backwards)
+        if self.return_sequences:
+            outs = jnp.moveaxis(outs, 0, 1)  # [B, T, OH, OW, F]
+            return outs[:, ::-1] if self.go_backwards else outs
+        return hid
+
+
+# -- unshared convolution ------------------------------------------------------
+
+class LocallyConnected2D(Module):
+    """Conv2D with UNSHARED weights per output position (reference:
+    LocallyConnected2D).  Patch extraction + per-position einsum — the
+    contraction maps onto the MXU as a batched matmul."""
+
+    def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: str = "valid", activation: Any = None,
+                 use_bias: bool = True, kernel_init: Any = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if isinstance(padding, str) and padding.lower() != "valid":
+            raise ValueError(
+                "LocallyConnected2D supports padding='valid' only (keras "
+                "semantics)")
+        self.filters = filters
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        b, h, w, c = x.shape
+        oh = (h - kh) // sh + 1
+        ow = (w - kw) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))  # [B,OH,OW,C*kh*kw]
+        kern = scope.param("kernel", self.kernel_init,
+                           (oh, ow, patches.shape[-1], self.filters))
+        y = jnp.einsum("bhwk,hwkf->bhwf", patches,
+                       kern.astype(patches.dtype))
+        if self.use_bias:
+            bias = scope.param("bias", initializers.get("zeros"),
+                               (oh, ow, self.filters))
+            y = y + bias.astype(y.dtype)
+        return self.activation(y)
+
+
+# -- transpose / separable variants -------------------------------------------
+
+def _deconv_pads(k: int, s: int, padding: str) -> Tuple[int, int]:
+    """Explicit pad pairs expressing a keras ConvTranspose as a
+    fractionally-strided (lhs-dilated) direct conv over a FLIPPED kernel —
+    the gradient-of-conv formulation, which is exactly keras/torch
+    deconvolution semantics (lax.conv_transpose's own SAME differs)."""
+    if padding == "VALID":
+        return (k - 1, k - 1)
+    pt = max(k - s, 0)  # forward SAME conv total padding
+    return (k - 1 - pt // 2, k - 1 - (pt - pt // 2) + max(s - k, 0))
+
+
+def _deconv(x: jax.Array, w: jax.Array, strides: Sequence[int],
+            padding: str, dn: Tuple[str, str, str]) -> jax.Array:
+    nd = len(strides)
+    flipped = w[(slice(None, None, -1),) * nd]
+    pads = [_deconv_pads(w.shape[i], strides[i], padding)
+            for i in range(nd)]
+    return jax.lax.conv_general_dilated(
+        x, flipped.astype(x.dtype), window_strides=(1,) * nd,
+        padding=pads, lhs_dilation=tuple(strides),
+        dimension_numbers=dn)
+
+
+class Conv3DTranspose(Module):
+    """3-D transposed convolution, NDHWC (reference: Deconvolution3D) —
+    exact keras Conv3DTranspose semantics via ``_deconv``."""
+
+    def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: str = "same", activation: Any = None,
+                 use_bias: bool = True, kernel_init: Any = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = _triple(kernel_size)
+        self.strides = _triple(strides)
+        self.padding = padding.upper()
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        kd, kh, kw = self.kernel_size
+        w = scope.param("kernel", self.kernel_init,
+                        (kd, kh, kw, x.shape[-1], self.filters))
+        y = _deconv(x, w, self.strides, self.padding,
+                    ("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            b = scope.param("bias", initializers.get("zeros"),
+                            (self.filters,))
+            y = y + b.astype(y.dtype)
+        return self.activation(y)
+
+
+class Conv1DTranspose(Module):
+    """1-D transposed convolution, NWC (keras2: Conv1DTranspose)."""
+
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "same", activation: Any = None,
+                 use_bias: bool = True, kernel_init: Any = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = kernel_size
+        self.strides = strides
+        self.padding = padding.upper()
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        w = scope.param("kernel", self.kernel_init,
+                        (self.kernel_size, x.shape[-1], self.filters))
+        y = _deconv(x, w, (self.strides,), self.padding,
+                    ("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            b = scope.param("bias", initializers.get("zeros"),
+                            (self.filters,))
+            y = y + b.astype(y.dtype)
+        return self.activation(y)
+
+
+class SeparableConv1D(Module):
+    """Depthwise-then-pointwise 1-D convolution (keras2: SeparableConv1D)."""
+
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "same", depth_multiplier: int = 1,
+                 activation: Any = None, use_bias: bool = True,
+                 kernel_init: Any = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = kernel_size
+        self.strides = strides
+        self.padding = padding.upper()
+        self.depth_multiplier = depth_multiplier
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        dw = scope.param("depthwise_kernel", self.kernel_init,
+                         (self.kernel_size, 1, c * self.depth_multiplier))
+        y = jax.lax.conv_general_dilated(
+            x, dw.astype(x.dtype), window_strides=(self.strides,),
+            padding=self.padding, dimension_numbers=("NWC", "WIO", "NWC"),
+            feature_group_count=c)
+        pw = scope.param("pointwise_kernel", self.kernel_init,
+                         (1, c * self.depth_multiplier, self.filters))
+        y = jax.lax.conv_general_dilated(
+            y, pw.astype(y.dtype), window_strides=(1,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            b = scope.param("bias", initializers.get("zeros"),
+                            (self.filters,))
+            y = y + b.astype(y.dtype)
+        return self.activation(y)
+
+
+# -- keras-2 extras ------------------------------------------------------------
+
+class AlphaDropout(Module):
+    """SELU-compatible dropout: keeps self-normalizing mean/variance
+    (keras2: AlphaDropout; Klambauer et al. 2017)."""
+
+    _ALPHA_P = -1.7580993408473766  # -alpha * lambda of SELU
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = float(rate)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        if not scope.training or self.rate <= 0.0:
+            return x
+        keep = 1.0 - self.rate
+        a = ((keep + self._ALPHA_P ** 2 * keep * (1 - keep)) ** -0.5)
+        b = -a * self._ALPHA_P * (1 - keep)
+        mask = jax.random.bernoulli(scope.make_rng(), keep, x.shape)
+        return a * jnp.where(mask, x, self._ALPHA_P) + b
+
+
+class Softmax(Module):
+    """Softmax as a layer with an axis argument (keras2: Softmax)."""
+
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class ActivityRegularization(Module):
+    """Identity layer adding an L1/L2 activity penalty to the training
+    loss (reference: ActivityRegularization).  The penalty rides the
+    framework's aux-loss channel: recorded under ``aux_loss`` in state,
+    summed into the loss by the estimator (same mechanism as the MoE
+    load-balance loss)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        scope.variable("aux_loss", lambda: jnp.zeros((), jnp.float32))
+        pen = (self.l1 * jnp.abs(x).sum()
+               + self.l2 * jnp.square(x).sum()).astype(jnp.float32)
+        scope.put_variable("aux_loss", pen)
+        return x
+
+
+# -- normalization -------------------------------------------------------------
+
+class LRN2D(Module):
+    """Cross-channel local response normalization, NHWC (reference: the
+    AlexNet-era LRN layer BigDL exposed through the keras set)."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float = 0.75,
+                 n: int = 5, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        half = self.n // 2
+        sq = jnp.square(x)
+        c = x.shape[-1]
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        window = sum(pad[..., i:i + c] for i in range(self.n))
+        # caffe/keras-1 LRN divides alpha by the window size
+        return x / jnp.power(self.k + (self.alpha / self.n) * window,
+                             self.beta)
+
+
+# -- merge variants ------------------------------------------------------------
+
+class Cos(Module):
+    """Cosine-proximity merge over the last axis (reference: keras-1
+    ``merge(mode="cos")``); output keeps a trailing singleton axis."""
+
+    def forward(self, scope: Scope, inputs: Sequence[jax.Array]) -> jax.Array:
+        a, b = inputs
+        num = jnp.sum(a * b, axis=-1, keepdims=True)
+        den = (jnp.linalg.norm(a, axis=-1, keepdims=True)
+               * jnp.linalg.norm(b, axis=-1, keepdims=True))
+        return num / jnp.maximum(den, 1e-12)
+
+
+# -- BigDL element-op layers ---------------------------------------------------
+
+class Identity(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x
+
+
+class Exp(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.exp(x)
+
+
+class Log(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.log(x)
+
+
+class Sqrt(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.sqrt(x)
+
+
+class Square(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.square(x)
+
+
+class Power(Module):
+    """x ** power, with optional pre-scale/shift: (a*x + b) ** p (BigDL
+    Power semantics)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.power(self.scale * x + self.shift, self.power)
+
+
+class Negative(Module):
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return -x
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x + self.constant
+
+
+class MulConstant(Module):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return x * self.constant
+
+
+class Scale(Module):
+    """Learnable per-channel affine: gamma * x + beta over the last axis
+    (BigDL Scale / CAddTable+CMulTable idiom)."""
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        gamma = scope.param("gamma", initializers.get("ones"), (dim,))
+        beta = scope.param("beta", initializers.get("zeros"), (dim,))
+        return x * gamma.astype(x.dtype) + beta.astype(x.dtype)
+
+
+class Threshold(Module):
+    """x if x > th else value (BigDL Threshold)."""
+
+    def __init__(self, th: float = 1e-6, value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.th, self.value = th, value
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.where(x > self.th, x, jnp.asarray(self.value, x.dtype))
+
+
+class HardShrink(Module):
+    def __init__(self, lam: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.lam = lam
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.where(jnp.abs(x) > self.lam, x, 0.0).astype(x.dtype)
+
+
+class SoftShrink(Module):
+    def __init__(self, lam: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.lam = lam
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return (jnp.sign(x)
+                * jnp.maximum(jnp.abs(x) - self.lam, 0.0)).astype(x.dtype)

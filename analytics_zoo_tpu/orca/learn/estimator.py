@@ -63,6 +63,48 @@ class Estimator:
     # analytics_zoo_tpu.models.net loaders.
     from_fn = from_keras
 
+    @staticmethod
+    def from_torch(*, model: Any, loss: Any, optimizer: Any = "adam",
+                   example_input: Any = None,
+                   learning_rate: Optional[Any] = None,
+                   metrics: Optional[Sequence[Any]] = None,
+                   **kwargs: Any) -> "ZooEstimator":
+        """Name-parity shim for ported reference scripts (reference:
+        ``Estimator.from_torch(model=..., loss=..., optimizer=...)`` —
+        pyzoo/zoo/orca/learn/pytorch/estimator.py).  A ``torch.nn.Module``
+        (or TorchScript path) is converted via ``Net.load_torch`` and then
+        trains natively; already-native ``nn.Module``s pass through so
+        scripts can migrate incrementally.
+
+        ``example_input``: one example batch (torch layout), required for
+        torch modules — conversion traces per-layer shapes with it."""
+        if not isinstance(model, Module):
+            from analytics_zoo_tpu.models.net import Net
+            if example_input is None:
+                raise ValueError(
+                    "from_torch needs example_input= (one example batch, "
+                    "torch layout) to convert a torch module")
+            model = Net.load_torch(model, example_input)
+        return ZooEstimator(model=model, loss=loss, optimizer=optimizer,
+                            learning_rate=learning_rate, metrics=metrics,
+                            **kwargs)
+
+    @staticmethod
+    def from_graph(model: Any, loss: Any, optimizer: Any = "adam",
+                   learning_rate: Optional[Any] = None,
+                   metrics: Optional[Sequence[Any]] = None,
+                   **kwargs: Any) -> "ZooEstimator":
+        """Name-parity shim for TF-graph reference scripts (reference:
+        ``Estimator.from_graph`` — pyzoo/zoo/orca/learn/tf/estimator.py).
+        Accepts a tf.keras model (object or saved path), converted via
+        ``Net.load_tf``; native ``nn.Module``s pass through."""
+        if not isinstance(model, Module):
+            from analytics_zoo_tpu.models.net import Net
+            model = Net.load_tf(model)
+        return ZooEstimator(model=model, loss=loss, optimizer=optimizer,
+                            learning_rate=learning_rate, metrics=metrics,
+                            **kwargs)
+
 
 class ZooEstimator:
     """The single concrete estimator."""
@@ -239,9 +281,17 @@ class ZooEstimator:
                 return carry, loss_val
             return jax.lax.scan(body, ts, None, length=k)
 
+        def multi_step_data(ts, batches):
+            """K train steps over K DISTINCT batches (leading [K] axis) in
+            one executable — the infeed-chunk pattern: one host→device
+            transfer and one dispatch amortize over K steps, while every
+            step still consumes fresh data."""
+            return jax.lax.scan(train_step, ts, batches)
+
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._multi_step = jax.jit(multi_step, static_argnums=2,
                                    donate_argnums=0)
+        self._multi_step_data = jax.jit(multi_step_data, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._pred_step = jax.jit(pred_step)
 

@@ -1,35 +1,49 @@
-"""Benchmark harness: flagship train-step throughput + MFU on real hardware.
+"""Benchmark harness: BASELINE-matrix throughput + MFU on real hardware.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Configs (BASELINE.md table; select with ``--config``, default bert):
+  bert      BERT-base MLM fine-tune — tokens/sec/chip + MFU, measured BOTH
+            on a device-resident batch (pure-compute MFU, lax.scan over K
+            steps) and end-to-end from StreamingDataFeed (fresh host
+            batches through the native queue with device_put overlap).
+            The headline number is the resident MFU; the streaming MFU is
+            in ``detail`` and must stay within ~10%% of it.
+  resnet50  ResNet-50 synthetic-ImageNet — images/sec/chip + MFU through
+            the streaming input pipeline (uint8 host batches, normalize
+            on device — 4x less PCIe traffic than f32).
+  lenet     LeNet/MNIST smoke — correctness (loss must fall) + step time.
+  ncf       NCF through the Friesian FeatureTable pipeline (string-id
+            encode -> negative sampling -> train) — examples/sec/chip.
+  autots    Chronos AutoTS search — trials/hour.
+
 The reference published no numbers (BASELINE.md); the acceptance bar from
-BASELINE.json is >=40% MFU on the BERT-base fine-tune config, so
-``vs_baseline`` = achieved_MFU / 0.40.
+BASELINE.json is >=40%% MFU for bert/resnet50 (``vs_baseline`` =
+achieved_MFU / 0.40) and correct completion for the other three
+(``vs_baseline`` = 1.0 on success).
 
-Config: BERT-base dims (d=768, 12 layers, 12 heads, vocab 30522, seq 512)
-with an MLM-style full-vocab head, bf16 activations (params f32, matmuls
-bf16 with f32 accumulation, loss softmax in f32 — nn/losses.py), AdamW.
-Per-chip batch 8 — a realistic fine-tune batch; measured sweep (B in
-{8,16,24,32,64}) shows throughput on v5e *decreases* with batch for this
-model, so the small batch is the honest best, not a trick.
+Resilience (the round-2 failure mode): the measurement runs in a CHILD
+process; the parent retries a crashed child up to 3 times with backoff, so
+a transient compile-service failure (e.g. ``remote_compile: read body``)
+costs a retry instead of the round's perf evidence.  rc=0 only with a real
+number on stdout.
 
-Timing: K steps fused into one executable (lax.scan in the estimator's
-_multi_step) so per-step dispatch overhead is amortized, timed around a
-single host transfer of the final loss.  No overhead subtraction.
-
-MFU denominator: per-chip peak bf16 FLOP/s looked up from device_kind
-(v5e=197e12 per public spec).  Unknown TPU kinds abort rather than
-report a silently-wrong MFU.
+MFU denominators: per-chip peak bf16 FLOP/s looked up from device_kind
+(v5e=197e12 per public spec); unknown TPU kinds abort rather than report a
+silently-wrong MFU.  BERT model FLOPs/token are analytic (6*N + attention
+term); ResNet FLOPs/image are taken from XLA's cost analysis of the
+compiled FORWARD pass (x3 for fwd+bwd) so they track the real model, with
+the canonical 4.089 GFLOPs-at-224 estimate as fallback.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 # Public peak bf16 dense FLOP/s per chip, keyed by device_kind substring.
 _PEAK_BF16 = [
@@ -43,8 +57,11 @@ _PEAK_BF16 = [
     ("v2", 45e12),
 ]
 
+CONFIGS = ("bert", "resnet50", "lenet", "ncf", "autots")
+
 
 def peak_flops_per_chip() -> float:
+    import jax
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         return 0.0  # CPU sim: MFU not meaningful; report raw throughput
@@ -69,14 +86,77 @@ def flops_per_token(d_model: int, n_layers: int, seq: int, vocab: int,
     return 6.0 * n_params + attn
 
 
-def main() -> None:
+def _emit(metric: str, value: float, unit: str, vs_baseline: float,
+          detail: dict) -> None:
+    print(json.dumps({
+        "metric": metric, "value": round(value, 1), "unit": unit,
+        "vs_baseline": round(vs_baseline, 4), "detail": detail,
+    }), flush=True)
+
+
+def _device_info():
+    import jax
+    dev = jax.devices()[0]
+    return jax.device_count(), dev.device_kind, peak_flops_per_chip()
+
+
+def _put_chunk(tree, mesh):
+    """Place a host [K, B, ...] chunk: batch dim (axis 1) sharded over the
+    mesh's data axis, step dim (axis 0) unsharded."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(leaf):
+        spec = [None] * leaf.ndim
+        if leaf.ndim >= 2 and "data" in mesh.axis_names:
+            spec[1] = "data"
+        return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+
+    return {k: put(v) for k, v in tree.items()}
+
+
+def _stream_train(est, feed, mesh, chunk_steps, n_chunks):
+    """End-to-end streaming training via infeed chunks: K fresh host
+    batches -> one device transfer -> one K-step scan executable
+    (Estimator._multi_step_data).  One dispatch and one host->device copy
+    amortize over K steps — the TPU-native infeed pattern; per-step
+    dispatch through this environment's device tunnel costs 100x more.
+    Returns (seconds, steps) measured AFTER a one-chunk compile warmup."""
+    import numpy as np
+
+    it = feed.epoch(mesh, 0, place=False)
+
+    def next_chunk():
+        host = [next(it) for _ in range(chunk_steps)]
+        return _put_chunk({k: np.stack([h[k] for h in host])
+                           for k in host[0]}, mesh)
+
+    est._ts, losses = est._multi_step_data(est._ts, next_chunk())
+    _ = float(losses[-1])  # block: compile stays out of the timed region
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        est._ts, losses = est._multi_step_data(est._ts, next_chunk())
+    _ = float(losses[-1])
+    return time.perf_counter() - t0, chunk_steps * n_chunks
+
+
+# -- bert ---------------------------------------------------------------------
+
+def bench_bert() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     import analytics_zoo_tpu.nn as nn
     from analytics_zoo_tpu.core import init_orca_context
-    from analytics_zoo_tpu.orca.learn import Estimator
     from analytics_zoo_tpu.data import as_feed
+    from analytics_zoo_tpu.data.stream import StreamingDataFeed
+    from analytics_zoo_tpu.orca.learn import Estimator
 
     d_model, n_heads, n_layers, vocab, seq = 768, 12, 12, 30522, 512
-    batch = 8  # per-chip; see module docstring for the sweep rationale
+    batch = 8  # per-chip; measured sweep (B in {8..64}): throughput on v5e
+    #            *decreases* with batch for this model, so 8 is the honest
+    #            best, not a trick
 
     class Encoder(nn.Module):
         def forward(self, scope, ids):
@@ -92,20 +172,21 @@ def main() -> None:
             return scope.child(nn.Dense(vocab), x, name="head")
 
     mesh = init_orca_context("local")
-    n_chips = jax.device_count()
-    model = Encoder()
+    n_chips, kind, peak = _device_info()
+    global_batch = batch * n_chips
 
     rng = np.random.default_rng(0)
-    global_batch = batch * n_chips
     ids = rng.integers(0, vocab, (global_batch, seq))
     labels = rng.integers(0, vocab, (global_batch, seq))
 
-    est = Estimator.from_keras(model, loss="sparse_categorical_crossentropy",
+    est = Estimator.from_keras(Encoder(),
+                               loss="sparse_categorical_crossentropy",
                                optimizer="adamw", learning_rate=1e-4)
     feed = as_feed((ids, labels), global_batch, shuffle=False)
     batch_dev = next(feed.epoch(mesh, 0))
     est._ensure_initialized(batch_dev["x"])
 
+    # -- phase 1: device-resident batch (pure-compute MFU) --------------------
     steps = 50
     # warmup: compiles the K-step executable and runs it once
     est._ts, warm_losses = est._multi_step(est._ts, batch_dev, steps)
@@ -115,28 +196,385 @@ def main() -> None:
     est._ts, losses = est._multi_step(est._ts, batch_dev, steps)
     _ = float(losses[-1])  # host transfer: the synchronization point
     dt = time.perf_counter() - t0
+    resident_tps = steps * global_batch * seq / dt
 
-    tokens_per_sec = steps * global_batch * seq / dt
-    tok_per_chip = tokens_per_sec / n_chips
+    # -- phase 2: end-to-end from the streaming input pipeline ----------------
+    # Fresh host batches every step: worker threads assemble token batches,
+    # push through the bounded native queue; the consumer stacks K batches
+    # into one infeed-chunk transfer + one K-step scan (_stream_train).
+    chunk_steps, n_chunks = 10, 3
+
+    def load_sample(i: int, rng=None) -> dict:
+        r = np.random.default_rng(i)
+        return {"x": r.integers(0, vocab, (seq,)),
+                "y": r.integers(0, vocab, (seq,))}
+
+    sfeed = StreamingDataFeed(
+        num_samples=(n_chunks + 2) * chunk_steps * global_batch,
+        load_sample=load_sample, batch_size=global_batch, shuffle=False,
+        num_workers=8, prefetch_batches=4)
+    stream_dt, n = _stream_train(est, sfeed, mesh, chunk_steps, n_chunks)
+    stream_tps = n * global_batch * seq / stream_dt
+
     fpt = flops_per_token(d_model, n_layers, seq, vocab)
-    peak = peak_flops_per_chip()
-    kind = jax.devices()[0].device_kind
     if peak > 0:
-        mfu = tokens_per_sec * fpt / (peak * n_chips)
+        mfu = resident_tps * fpt / (peak * n_chips)
+        stream_mfu = stream_tps * fpt / (peak * n_chips)
         vs_baseline = mfu / 0.40
     else:
-        mfu = 0.0
-        vs_baseline = 0.0  # CPU sim: no MFU claim
-    print(json.dumps({
-        "metric": "bert_base_train_tokens_per_sec_per_chip",
-        "value": round(tok_per_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(vs_baseline, 4),
-        "detail": {"mfu": round(mfu, 4), "chips": n_chips,
-                   "step_ms": round(1000 * dt / steps, 2),
-                   "device_kind": kind, "peak_bf16_flops": peak,
-                   "per_chip_batch": batch, "seq": seq},
-    }))
+        mfu = stream_mfu = vs_baseline = 0.0  # CPU sim: no MFU claim
+    _emit("bert_base_train_tokens_per_sec_per_chip",
+          resident_tps / n_chips, "tokens/s/chip", vs_baseline,
+          {"mfu": round(mfu, 4),
+           "streaming_mfu": round(stream_mfu, 4),
+           "streaming_tokens_per_sec_per_chip":
+               round(stream_tps / n_chips, 1),
+           "streaming_over_resident": round(stream_tps / resident_tps, 4),
+           "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
+           "streaming_step_ms": round(1000 * stream_dt / n, 2),
+           "device_kind": kind, "peak_bf16_flops": peak,
+           "per_chip_batch": batch, "seq": seq})
+
+
+# -- resnet50 -----------------------------------------------------------------
+
+def bench_resnet50() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.data import as_feed
+    from analytics_zoo_tpu.data.stream import StreamingDataFeed
+    from analytics_zoo_tpu.models import ResNet
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    size, classes = 224, 1000
+    batch = 128  # per-chip; measured sweep (64/128/256 -> 9.8/12.3/12.8%
+    #              MFU): 128 is the knee, 256 doubles latency for +4%
+
+    class TrainNet(nn.Module):
+        """uint8 NHWC images -> on-device normalize -> bf16 ResNet-50.
+        uint8 payload: 4x less host->device traffic than f32."""
+
+        def __init__(self):
+            super().__init__()
+            self.net = ResNet(depth=50, class_num=classes, dtype="bfloat16")
+
+        def forward(self, scope, x):
+            x = (x.astype(jnp.bfloat16) - 127.0) * (1.0 / 64.0)
+            return scope.child(self.net, x, name="resnet")
+
+    mesh = init_orca_context("local")
+    n_chips, kind, peak = _device_info()
+    global_batch = batch * n_chips
+
+    # DRAM-cached image pool (the reference FeatureSet cached the training
+    # set in DRAM/PMEM): workers copy + random-flip a pool image per sample,
+    # so the loader cost is a realistic memcpy+augment, not numpy RNG.
+    pool_rng = np.random.default_rng(0)
+    pool = pool_rng.integers(0, 256, (256, size, size, 3), dtype=np.uint8)
+    pool_labels = pool_rng.integers(0, classes, (256,))
+
+    def load_sample(i: int, rng=None) -> dict:
+        r = rng if rng is not None else np.random.default_rng(i)
+        j = int(r.integers(0, len(pool)))
+        img = pool[j]
+        if r.integers(0, 2):
+            img = img[:, ::-1]  # horizontal flip
+        return {"x": np.ascontiguousarray(img),
+                "y": np.int32(pool_labels[j])}
+
+    chunk_steps, n_chunks = 5, 4
+    est = Estimator.from_keras(TrainNet(),
+                               loss="sparse_categorical_crossentropy",
+                               optimizer="sgd", learning_rate=0.1)
+    feed0 = as_feed((pool[:global_batch].copy(),
+                     pool_labels[:global_batch].astype(np.int32)),
+                    global_batch, shuffle=False)
+    b0 = next(feed0.epoch(mesh, 0))
+    est._ensure_initialized(b0["x"])
+
+    # model FLOPs/image from XLA's cost analysis of the compiled forward
+    def fwd(v, x):
+        out, _ = est.model.apply(v, x, training=False)
+        return out
+
+    flops_per_image = 0.0
+    try:
+        var_struct = {"params": est._ts["params"], "state": est._ts["state"]}
+        cost = (jax.jit(fwd).lower(var_struct, b0["x"]).compile()
+                .cost_analysis())
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_image = float(cost.get("flops", 0.0)) / global_batch
+    except Exception:
+        pass
+    if flops_per_image <= 0:  # canonical RN50 estimate, res-scaled
+        flops_per_image = 4.089e9 * (size / 224.0) ** 2
+    train_flops_per_image = 3.0 * flops_per_image  # bwd ~= 2x fwd
+
+    # -- phase 1: device-resident batch (pure-compute MFU, the headline;
+    # stable against the device tunnel's transfer-throughput swings) ------
+    steps = 20
+    est._ts, warm = est._multi_step(est._ts, b0, steps)
+    _ = float(warm[-1])
+    t0 = time.perf_counter()
+    est._ts, losses = est._multi_step(est._ts, b0, steps)
+    _ = float(losses[-1])
+    dt = time.perf_counter() - t0
+    ips = steps * global_batch / dt
+
+    # -- phase 2: end-to-end streaming via infeed chunks ------------------
+    feed2 = StreamingDataFeed(
+        num_samples=(n_chunks + 2) * chunk_steps * global_batch,
+        load_sample=load_sample, batch_size=global_batch, shuffle=False,
+        num_workers=8, prefetch_batches=4)
+    stream_dt, n = _stream_train(est, feed2, mesh, chunk_steps, n_chunks)
+    stream_ips = n * global_batch / stream_dt
+
+    if peak > 0:
+        mfu = ips * train_flops_per_image / (peak * n_chips)
+        stream_mfu = stream_ips * train_flops_per_image / (peak * n_chips)
+        vs_baseline = mfu / 0.40
+    else:
+        mfu = stream_mfu = vs_baseline = 0.0
+    _emit("resnet50_train_images_per_sec_per_chip", ips / n_chips,
+          "images/s/chip", vs_baseline,
+          {"mfu": round(mfu, 4), "streaming_mfu": round(stream_mfu, 4),
+           "streaming_images_per_sec_per_chip":
+               round(stream_ips / n_chips, 1),
+           "streaming_over_resident": round(stream_ips / ips, 4),
+           "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
+           "streaming_step_ms": round(1000 * stream_dt / n, 2),
+           "fwd_gflops_per_image": round(flops_per_image / 1e9, 3),
+           "device_kind": kind, "peak_bf16_flops": peak,
+           "per_chip_batch": batch, "image_size": size,
+           "input": "streaming uint8, normalize on device"})
+
+
+# -- lenet --------------------------------------------------------------------
+
+def bench_lenet() -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.data import as_feed
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    mesh = init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = rng.normal(0.0, 0.1, (n, 28, 28, 1)).astype(np.float32)
+    for i in range(n):  # class-conditional blobs: learnable signal
+        r, c = divmod(int(y[i]), 4)
+        x[i, 7 * r:7 * r + 7, 7 * c:7 * c + 7, 0] += 1.0
+
+    model = nn.Sequential([
+        nn.Conv2D(6, 5, padding="same", activation="tanh"),
+        nn.MaxPooling2D(2),
+        nn.Conv2D(16, 5, activation="tanh"),
+        nn.MaxPooling2D(2),
+        nn.Flatten(),
+        nn.Dense(120, activation="tanh"),
+        nn.Dense(84, activation="tanh"),
+        nn.Dense(10),
+    ])
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               optimizer="adam", learning_rate=1e-3)
+    batch = 64 * n_chips
+    hist = est.fit((x, y), epochs=3, batch_size=batch, verbose=False)
+    learned = hist["loss"][-1] < hist["loss"][0] * 0.7
+
+    feed = as_feed((x, y), batch, shuffle=False)
+    batch_dev = next(feed.epoch(mesh, 0))
+    steps = 50
+    est._ts, warm = est._multi_step(est._ts, batch_dev, steps)
+    _ = float(warm[-1])
+    t0 = time.perf_counter()
+    est._ts, losses = est._multi_step(est._ts, batch_dev, steps)
+    _ = float(losses[-1])
+    dt = time.perf_counter() - t0
+
+    _emit("lenet_mnist_step_time_ms", 1000 * dt / steps, "ms/step",
+          1.0 if learned else 0.0,
+          {"loss_first_epoch": round(hist["loss"][0], 4),
+           "loss_last_epoch": round(hist["loss"][-1], 4),
+           "learned": learned, "chips": n_chips, "device_kind": kind,
+           "global_batch": batch})
+
+
+# -- ncf ----------------------------------------------------------------------
+
+def bench_ncf() -> None:
+    import numpy as np
+    import pandas as pd
+
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.friesian import FeatureTable
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+
+    # synthetic implicit feedback through the FULL tabular pipeline:
+    # string ids -> encode -> negative sampling -> arrays
+    rng = np.random.default_rng(0)
+    n_rows, n_users, n_items = 200_000, 2000, 1500
+    users = rng.integers(0, n_users, n_rows)
+    half = n_items // 2
+    items = np.where(users % 2 == 0, rng.integers(0, half, n_rows),
+                     rng.integers(half, n_items, n_rows))
+    df = pd.DataFrame({"user": [f"u{u}" for u in users],
+                       "item": [f"i{i}" for i in items]})
+
+    t_feat = time.perf_counter()
+    tbl = FeatureTable.from_pandas(df)
+    tbl, user_idx = tbl.encode_string("user")
+    tbl, item_idx = tbl.encode_string("item")
+    tbl = tbl.negative_sample(n_items, item_col="item", neg_num=2)
+    feat_dt = time.perf_counter() - t_feat
+    pdf = tbl.to_pandas()
+    xy = (np.stack([pdf["user"].to_numpy(), pdf["item"].to_numpy()], 1)
+          .astype(np.int32), pdf["label"].to_numpy().astype(np.int32))
+
+    model = NeuralCF(user_count=n_users + 1, item_count=n_items + 1,
+                     class_num=2)
+    est = Estimator.from_keras(model,
+                               loss="sparse_categorical_crossentropy",
+                               optimizer="adam", learning_rate=1e-3)
+    batch = 2048 * n_chips
+    est.fit(xy, epochs=1, batch_size=batch, verbose=False)  # warm/compile
+    t0 = time.perf_counter()
+    hist = est.fit(xy, epochs=1, batch_size=batch, verbose=False)
+    dt = time.perf_counter() - t0
+    n_examples = (len(xy[0]) // batch) * batch
+    eps = n_examples / dt
+
+    _emit("ncf_train_examples_per_sec_per_chip", eps / n_chips,
+          "examples/s/chip", 1.0,
+          {"rows_after_negative_sampling": len(xy[0]),
+           "feature_pipeline_s": round(feat_dt, 2),
+           "epoch_loss": round(hist["loss"][-1], 4),
+           "chips": n_chips, "device_kind": kind, "global_batch": batch})
+
+
+# -- autots -------------------------------------------------------------------
+
+def bench_autots() -> None:
+    import numpy as np
+    import pandas as pd
+
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset
+    from analytics_zoo_tpu.core import init_orca_context
+
+    init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+
+    t_idx = pd.date_range("2024-01-01", periods=2000, freq="h")
+    rng = np.random.default_rng(0)
+    value = (np.sin(np.arange(2000) * (2 * np.pi / 24))
+             + 0.1 * rng.normal(size=2000))
+    df = pd.DataFrame({"timestamp": t_idx, "value": value})
+    train, _, _ = TSDataset.from_pandas(df, dt_col="timestamp",
+                                        target_col="value", with_split=True,
+                                        test_ratio=0.1)
+    train.scale()
+
+    n_sampling = 8
+    auto = AutoTSEstimator(model=["lstm", "tcn"], past_seq_len=24,
+                           future_seq_len=4)
+    t0 = time.perf_counter()
+    pipeline = auto.fit(train, epochs=1, n_sampling=n_sampling)
+    dt = time.perf_counter() - t0
+    n_trials = len(getattr(auto, "trials", []) or []) or n_sampling
+    trials_per_hour = 3600.0 * n_trials / dt
+
+    _emit("autots_search_trials_per_hour", trials_per_hour, "trials/hour",
+          1.0 if pipeline is not None else 0.0,
+          {"n_trials": n_trials, "search_s": round(dt, 1),
+           "best_config": {k: (round(v, 6) if isinstance(v, float) else v)
+                           for k, v in (auto.best_config or {}).items()},
+           "chips": n_chips, "device_kind": kind})
+
+
+# -- driver -------------------------------------------------------------------
+
+_BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
+            "lenet": bench_lenet, "ncf": bench_ncf, "autots": bench_autots}
+
+
+def _run_child(config: str, attempts: int = 3) -> int:
+    """Run the measurement in a fresh child process; retry transient
+    failures (compile-service flakes and the like) with backoff."""
+    delay = 5.0
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--config",
+                 config, "--_worker"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                timeout=3600)
+        except subprocess.TimeoutExpired:
+            # a hung child (e.g. a compile-service stall) is exactly the
+            # failure mode the retry harness exists for
+            sys.stderr.write(
+                f"bench attempt {attempt}/{attempts}: child timed out "
+                "after 3600s; retrying\n")
+            if attempt < attempts:
+                time.sleep(delay)
+                delay *= 3
+            continue
+        line = None
+        for ln in reversed(proc.stdout.splitlines()):
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    parsed = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in parsed and "vs_baseline" in parsed:
+                    line = ln
+                    break
+        if proc.returncode == 0 and line is not None:
+            print(line, flush=True)
+            return 0
+        sys.stderr.write(
+            f"bench attempt {attempt}/{attempts} failed "
+            f"(rc={proc.returncode}); stderr tail:\n"
+            + "\n".join(proc.stderr.splitlines()[-15:]) + "\n")
+        if attempt < attempts:
+            time.sleep(delay)
+            delay *= 3
+    return 1
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", choices=CONFIGS, default="bert")
+    parser.add_argument("--_worker", action="store_true",
+                        help="internal: run the measurement in-process")
+    parser.add_argument("--attempts", type=int, default=3)
+    args = parser.parse_args()
+    if args._worker:
+        if os.environ.get("BENCH_FORCE_CPU"):
+            # CI coverage without a chip: 8-device CPU sim (XLA_FLAGS
+            # --xla_force_host_platform_device_count must also be set in
+            # the env).  Platform choice must go through jax.config since
+            # the environment's sitecustomize imports jax before us.
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        _BENCHES[args.config]()
+        return
+    sys.exit(_run_child(args.config, args.attempts))
 
 
 if __name__ == "__main__":
