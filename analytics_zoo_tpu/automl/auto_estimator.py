@@ -46,18 +46,26 @@ class AutoEstimator:
             batch_size: Any = 32, n_sampling: int = 4,
             search_space: Optional[Dict[str, Any]] = None,
             scheduler: Optional[ASHAScheduler] = None,
+            max_concurrent: int = 1,
             seed: int = 0) -> "AutoEstimator":
         """Search; then keep the best trained estimator.
 
         ``scheduler``: an ASHAScheduler, or the string "asha" for default
-        ASHA settings (reference: tune scheduler names)."""
+        ASHA settings (reference: tune scheduler names).
+
+        ``max_concurrent``: trials running at once (reference:
+        RayTuneSearchEngine ran one trial per Ray worker).  Trials run in
+        a thread pool — XLA releases the GIL during compute, so CPU-host
+        trials genuinely overlap; on a single TPU pod keep 1 (one pod =
+        one trial)."""
         from analytics_zoo_tpu.orca.learn import Estimator
         search_space = dict(search_space or {})
         val = validation_data if validation_data is not None else data
         if scheduler == "asha":
             scheduler = ASHAScheduler(metric_mode=self.metric_mode)
         engine = self.engine or RandomSearchEngine(
-            metric_mode=self.metric_mode, scheduler=scheduler, seed=seed)
+            metric_mode=self.metric_mode, scheduler=scheduler,
+            max_concurrent=max_concurrent, seed=seed)
         self.engine = engine
 
         def trial_fn(config: Dict[str, Any], report) -> float:

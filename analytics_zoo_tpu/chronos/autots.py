@@ -134,9 +134,11 @@ class AutoTSEstimator:
 
     def fit(self, data, validation_data=None, epochs: int = 2,
             batch_size: int = 32, n_sampling: int = 4,
-            scheduler: Optional[ASHAScheduler] = None) -> TSPipeline:
+            scheduler: Optional[ASHAScheduler] = None,
+            max_concurrent: int = 1) -> TSPipeline:
         """``data``: a TSDataset (re-rolled per lookback candidate) or a
-        rolled (x, y) tuple."""
+        rolled (x, y) tuple.  ``max_concurrent``: parallel trials (thread
+        pool; XLA releases the GIL during compute)."""
         from .data import TSDataset
         is_tsdata = isinstance(data, TSDataset)
         space = dict(self.search_space)
@@ -144,7 +146,9 @@ class AutoTSEstimator:
         if isinstance(self.past_seq_len, hp_mod.Sampler):
             space["past_seq_len"] = self.past_seq_len
         engine = RandomSearchEngine(metric_mode=self.metric_mode,
-                                    scheduler=scheduler, seed=self.seed)
+                                    scheduler=scheduler,
+                                    max_concurrent=max_concurrent,
+                                    seed=self.seed)
 
         def make(config: Dict[str, Any]):
             cfg = dict(config)

@@ -158,6 +158,15 @@ class FeedBase:
         m[:real] = 1.0
         return m
 
+    def dropped_rows(self, epoch_idx: int = 0):
+        """The rows a drop_remainder epoch skips, respecting THAT epoch's
+        shuffle order (shuffled feeds drop a permutation-dependent tail).
+        None if nothing is dropped or the subclass cannot reconstruct them
+        (callers fall back to a warning)."""
+        if not self.shuffle:
+            return self.remainder()
+        return None
+
 
 class DataFeed(FeedBase):
     """An epoch-iterable source of device-resident, mesh-sharded batches,
@@ -206,6 +215,15 @@ class DataFeed(FeedBase):
         if r == 0:
             return None
         sel = np.arange(self._n - r, self._n)
+        return jax.tree_util.tree_map(lambda a: _take(a, sel), self._data)
+
+    def dropped_rows(self, epoch_idx: int = 0):
+        """Exact drop_remainder coverage even when shuffled: the dropped
+        rows are the tail of THIS epoch's permutation."""
+        r = self._n % self._local_batch
+        if r == 0:
+            return None
+        sel = self._epoch_index(epoch_idx)[self._n - r:]
         return jax.tree_util.tree_map(lambda a: _take(a, sel), self._data)
 
     def epoch(self, mesh: Mesh, epoch_idx: int = 0

@@ -57,6 +57,17 @@ class StreamingDataFeed(FeedBase):
         rows = [self._load(i, rng=rng) for i in range(self._n - r, self._n)]
         return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
 
+    def dropped_rows(self, epoch_idx: int = 0):
+        """Exact drop_remainder coverage even when shuffled: reload the
+        tail of this epoch's permutation through the sample loader."""
+        r = self._n % self._local_batch
+        if r == 0:
+            return None
+        sel = self._epoch_index(epoch_idx)[self._n - r:]
+        rng = np.random.default_rng(self.seed)
+        rows = [self._load(int(i), rng=rng) for i in sel]
+        return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
+
     def epoch(self, mesh: Mesh, epoch_idx: int = 0, place: bool = True
               ) -> Iterator[Dict[str, "np.ndarray"]]:
         """``place=False`` yields host numpy batches (no device placement):

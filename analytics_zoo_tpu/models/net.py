@@ -690,9 +690,28 @@ def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
             f"{tname}{tuple(tail)} is unsupported (only (B, -1) flattens "
             "convert); see the escape hatch")
 
+    if tname == "size":
+        # x.size(d): a static int at conversion time (shapes are traced).
+        # For 4-D values only the batch dim keeps its index in NHWC.
+        if len(n.args) < 2:
+            raise NotImplementedError(
+                "x.size() as a tuple is unsupported; use the escape hatch")
+        d = n.args[1]
+        if is4d and d not in (0, -4):
+            raise NotImplementedError(
+                f"x.size({d}) on a 4-D NCHW tensor has a layout-dependent "
+                "meaning after NHWC conversion; use the escape hatch")
+        return node(lambda v, dd=d: v.shape[dd], n.args[:1])
+
     if tname in ("cat", "concat"):
         tensors = n.args[0]
         dim = (n.args[1] if len(n.args) > 1 else n.kwargs.get("dim", 0))
+        if any(res(t) in flat_origin for t in tensors
+               if isinstance(t, fx.Node)):
+            raise NotImplementedError(
+                "cat of flattened NCHW feature maps feeding a Linear would "
+                "need a per-segment kernel reorder, which is unsupported; "
+                "use the escape hatch")
         shapes = [shp(t) for t in tensors]
         if all(s is not None and len(s) == 4 for s in shapes):
             if dim in (1, -3):
@@ -710,8 +729,9 @@ def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
 
     if tname == "softmax":
         dim = (n.args[1] if len(n.args) > 1 else n.kwargs.get("dim", -1))
-        if is4d and dim in (1, -3):
-            dim = -1
+        if is4d:
+            # full NCHW->NHWC axis map: batch 0->0, C 1->-1, H 2->1, W 3->2
+            dim = {0: 0, 1: -1, 2: 1, 3: 2}[dim % 4]
         return node(lambda v, d=dim: jax.nn.softmax(v, axis=d), n.args[:1])
 
     if tname == "mean":
@@ -894,6 +914,14 @@ def _load_keras_functional(model) -> ForeignGraphNet:
     for l in cfg["layers"]:
         visit(l["name"])
 
+    # input ORDER must come from the model spec (cfg['input_layers']), not
+    # graph-walk order — Model(inputs=[a, b]) binds positionally
+    in_spec = cfg.get("input_layers")
+    if (isinstance(in_spec, (list, tuple)) and len(in_spec) == 3
+            and isinstance(in_spec[0], str)):
+        in_spec = [in_spec]
+    declared_inputs = [t[0] for t in (in_spec or [])]
+
     for name in order:
         lc = layer_cfgs[name]
         kind = lc["class_name"]
@@ -923,6 +951,8 @@ def _load_keras_functional(model) -> ForeignGraphNet:
         if s:
             state[name] = s
 
+    if declared_inputs and set(declared_inputs) == set(input_names):
+        input_names = declared_inputs
     return ForeignGraphNet(input_names, nodes, res(output_name),
                            {"params": params, "state": state}, source="tf")
 

@@ -59,21 +59,22 @@ class ConvLSTM2D(Module):
                          (kh, kw, f, 4 * f))
         bias = scope.param("bias", initializers.get("zeros"), (4 * f,))
 
-        def conv(inp, kern, strides):
+        def conv(inp, kern, strides, padding):
             return jax.lax.conv_general_dilated(
-                inp, kern, window_strides=strides, padding=self.padding,
+                inp, kern, window_strides=strides, padding=padding,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
-        # spatial dims after the (possibly strided) input conv; the
-        # recurrent conv is stride-1 SAME over that grid
-        oh = jax.eval_shape(lambda a: conv(a, wx, self.strides),
-                            jax.ShapeDtypeStruct((b, h, w, c), x.dtype)
-                            ).shape[1:3]
+        # spatial dims after the (possibly strided/valid) input conv; the
+        # recurrent conv is ALWAYS stride-1 SAME over that grid (keras
+        # semantics — it must preserve the hidden-state shape)
+        oh = jax.eval_shape(
+            lambda a: conv(a, wx, self.strides, self.padding),
+            jax.ShapeDtypeStruct((b, h, w, c), x.dtype)).shape[1:3]
 
         def step(carry, xt):
             hid, cell = carry
-            z = (conv(xt, wx, self.strides)
-                 + conv(hid, wh, (1, 1)) + bias)
+            z = (conv(xt, wx, self.strides, self.padding)
+                 + conv(hid, wh, (1, 1), "SAME") + bias)
             i, fg, g, o = jnp.split(z, 4, axis=-1)
             cell = jax.nn.sigmoid(fg) * cell + jax.nn.sigmoid(i) * jnp.tanh(g)
             hid = jax.nn.sigmoid(o) * jnp.tanh(cell)

@@ -445,21 +445,19 @@ class ZooEstimator:
         for step, batch in enumerate(feed.epoch(mesh, 0)):
             totals = accumulate(totals, batch, step)
         if feed.drop_remainder:
-            if getattr(feed, "shuffle", False):
-                # the dropped rows are permutation-dependent; the
-                # (unshuffled) remainder would double-count others
+            # user-constructed training feed: cover the dropped tail with a
+            # padded + masked extra batch.  dropped_rows respects the
+            # epoch-0 permutation, so shuffled feeds are exact too.
+            rem = (feed.dropped_rows(0) if hasattr(feed, "dropped_rows")
+                   else feed.remainder())
+            if rem is not None:
+                totals = accumulate(totals,
+                                    _pad_remainder(rem, feed, mesh), -1)
+            elif getattr(feed, "shuffle", False):
                 logger.warning(
-                    "evaluate on a shuffled drop_remainder feed: metrics "
-                    "exclude the rows the shuffle dropped this epoch; use "
-                    "shuffle=False or drop_remainder=False for exact "
-                    "coverage")
-            else:
-                # user-constructed training feed: cover the dropped tail
-                # with a padded + masked extra batch of the same shape
-                rem = feed.remainder()
-                if rem is not None:
-                    totals = accumulate(totals,
-                                        _pad_remainder(rem, feed, mesh), -1)
+                    "evaluate on a shuffled drop_remainder feed that cannot "
+                    "reconstruct its dropped rows: metrics exclude the rows "
+                    "the shuffle dropped this epoch")
         if totals is None:
             raise ValueError("evaluate got no batches")
         out = {"loss": float(totals[0][0] / jnp.maximum(totals[0][1], 1.0))}

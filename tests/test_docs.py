@@ -1,0 +1,26 @@
+"""Docs are executable: every bare ```python block in docs/*.md runs
+(VERDICT r2 #10 — per-subsystem pages with runnable snippets,
+import-checked in CI).  Blocks within one file share a namespace and run
+in order; illustrative snippets that need external files/servers are
+fenced as ```python no-run and excluded."""
+
+import pathlib
+import re
+
+import pytest
+
+DOCS = sorted((pathlib.Path(__file__).parent.parent / "docs").glob("*.md"))
+_BLOCK = re.compile(r"```python\n(.*?)```", re.S)
+
+
+@pytest.mark.parametrize("doc", DOCS, ids=[d.name for d in DOCS])
+def test_doc_snippets_execute(doc):
+    blocks = _BLOCK.findall(doc.read_text())
+    if not blocks:
+        pytest.skip("no python blocks")
+    ns: dict = {}
+    for i, code in enumerate(blocks):
+        try:
+            exec(compile(code, f"{doc.name}[block {i}]", "exec"), ns)
+        except Exception as e:
+            pytest.fail(f"{doc.name} block {i} failed: {e}")

@@ -264,3 +264,12 @@ def test_conv2d_transpose_matches_tf():
                          np.asarray(p["bias"])])
         np.testing.assert_allclose(out, ktf(x).numpy(), atol=2e-5,
                                    err_msg=f"k={k} s={s}")
+
+
+def test_convlstm2d_valid_padding():
+    """Regression (r3 review): padding='valid' shrinks the input conv grid
+    but the recurrent conv must stay SAME over that grid."""
+    x = np.random.default_rng(9).normal(size=(2, 3, 8, 8, 3)).astype(
+        np.float32)
+    _, out = run(nn.ConvLSTM2D(4, 3, padding="valid"), x)
+    assert out.shape == (2, 6, 6, 4)

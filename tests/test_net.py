@@ -504,3 +504,99 @@ def test_fx_functional_pool_with_padding_and_ceil_mode():
 
     with pytest.raises(NotImplementedError, match="ceil_mode"):
         Net.load_torch_graph(Ceil().eval(), x)
+
+
+def test_fx_view_size_flatten_pattern():
+    """Regression (r3 review): the classic x.view(x.size(0), -1) flatten
+    converts (a call_method 'size' node precedes the view)."""
+    init_orca_context("local")
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(3, 4, 3, padding=1)
+            self.fc = torch.nn.Linear(4 * 5 * 5, 2)
+
+        def forward(self, x):
+            h = self.conv(x)
+            h = h + h
+            return self.fc(h.view(h.size(0), -1))
+
+    m = M().eval()
+    x = np.random.default_rng(0).normal(size=(2, 3, 5, 5)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = m(torch.as_tensor(x)).numpy()
+    net = Net.load_torch_graph(m, x)
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_fx_softmax_axis_mapping_on_4d():
+    """Regression (r3 review): softmax over any NCHW dim maps to the
+    right NHWC axis."""
+    init_orca_context("local")
+
+    class M(torch.nn.Module):
+        def __init__(self, dim):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(3, 4, 1)
+            self.dim = dim
+
+        def forward(self, x):
+            h = self.conv(x)
+            return torch.softmax(h + h, dim=self.dim)
+
+    x = np.random.default_rng(1).normal(size=(2, 3, 4, 5)).astype(
+        np.float32)
+    for dim in (1, 2, 3):
+        m = M(dim).eval()
+        with torch.no_grad():
+            want = m(torch.as_tensor(x)).numpy()
+        net = Net.load_torch_graph(m, x)
+        np.testing.assert_allclose(_apply(net, x), want, atol=1e-5,
+                                   err_msg=f"dim={dim}")
+
+
+def test_fx_cat_of_flattened_branches_raises():
+    """Regression (r3 review): cat of two flattened NCHW maps into a
+    Linear cannot be silently mis-ordered — it must raise."""
+    init_orca_context("local")
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(3, 4, 1)
+            self.c2 = torch.nn.Conv2d(3, 4, 1)
+            self.fc = torch.nn.Linear(2 * 4 * 4 * 4, 2)
+
+        def forward(self, x):
+            a = torch.flatten(self.c1(x), 1)
+            b = torch.flatten(self.c2(x), 1)
+            return self.fc(torch.cat([a, b], dim=1))
+
+    x = np.zeros((2, 3, 4, 4), np.float32)
+    with pytest.raises(NotImplementedError, match="escape hatch"):
+        Net.load_torch_graph(M().eval(), x)
+
+
+def test_load_tf_functional_input_order_from_spec():
+    """Regression (r3 review): multi-input binding follows
+    Model(inputs=[a, b]) order, not layer-creation order."""
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    init_orca_context("local")
+    # create b BEFORE a so creation order disagrees with inputs=[a, b]
+    b = keras.Input((3,), name="in_b")
+    a = keras.Input((3,), name="in_a")
+    out = keras.layers.Subtract(name="sub")([
+        keras.layers.Dense(3, name="da")(a),
+        keras.layers.Dense(3, name="db")(b)])
+    model = keras.Model([a, b], out)
+    xa = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    xb = np.random.default_rng(1).normal(size=(2, 3)).astype(np.float32)
+    want = model([xa, xb], training=False).numpy()
+    net = Net.load_tf(model)
+    import jax
+    variables = net.init(jax.random.PRNGKey(0), xa, xb)
+    got, _ = net.apply(variables, xa, xb)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
