@@ -66,6 +66,12 @@ class AutoEstimator:
         engine = self.engine or RandomSearchEngine(
             metric_mode=self.metric_mode, scheduler=scheduler,
             max_concurrent=max_concurrent, seed=seed)
+        # fit()'s arguments must take effect on a pre-existing engine too
+        # (custom search_engine, or a second fit() on the cached engine)
+        if max_concurrent != 1:
+            engine.max_concurrent = max_concurrent
+        if scheduler is not None:
+            engine.scheduler = scheduler
         self.engine = engine
 
         def trial_fn(config: Dict[str, Any], report) -> float:

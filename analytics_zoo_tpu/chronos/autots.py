@@ -150,14 +150,20 @@ class AutoTSEstimator:
                                     max_concurrent=max_concurrent,
                                     seed=self.seed)
 
+        import threading
+        roll_lock = threading.Lock()  # concurrent trials share `data`:
+        # roll() mutates the dataset's window state, so window extraction
+        # must be atomic per trial (the arrays it returns are fresh copies)
+
         def make(config: Dict[str, Any]):
             cfg = dict(config)
             name = cfg.pop("model")
             lookback = int(cfg.pop("past_seq_len", self.past_seq_len))
             lr = cfg.pop("lr", 1e-3)
             if is_tsdata:
-                data.roll(lookback, self.future_seq_len)
-                x, y = data.to_numpy()
+                with roll_lock:
+                    data.roll(lookback, self.future_seq_len)
+                    x, y = data.to_numpy()
             else:
                 x, y = data
                 lookback = x.shape[1]

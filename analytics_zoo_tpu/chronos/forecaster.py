@@ -329,25 +329,161 @@ class ARIMAForecaster:
                 "mae": float(np.mean(np.abs(err)))}
 
 
-class ProphetForecaster:
-    """prophet wrapper (optional dep, import-gated)."""
+class _NumpyProphet:
+    """Prophet-style decomposable model via ridge regression: piecewise-
+    linear trend (changepoint basis, L2 on slope changes) + Fourier
+    seasonalities — Prophet's own model family (Taylor & Letham 2017),
+    fitted as a linear system instead of Stan MAP.  Exists so
+    ProphetForecaster EXECUTES in images without the prophet package."""
 
-    def __init__(self, **prophet_kwargs: Any):
-        try:
-            from prophet import Prophet  # noqa: F401
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "ProphetForecaster requires the optional 'prophet' package"
-            ) from e
+    def __init__(self, n_changepoints: int = 25,
+                 changepoint_range: float = 0.8,
+                 yearly_order: int = 10, weekly_order: int = 3,
+                 daily_order: int = 4, reg: float = 10.0,
+                 force_seasons: Sequence[str] = ()):
+        self.n_changepoints = n_changepoints
+        self.changepoint_range = changepoint_range
+        self.orders = {"yearly": (365.25, yearly_order),
+                       "weekly": (7.0, weekly_order),
+                       "daily": (1.0, daily_order)}
+        # explicitly requested components are fitted regardless of span
+        # (Prophet semantics: an explicit True overrides the auto gate)
+        self.force_seasons = set(force_seasons)
+        self.reg = reg
+
+    def _design(self, t_days: np.ndarray) -> np.ndarray:
+        cols = [np.ones_like(t_days), t_days]
+        for cp in self._cps:
+            cols.append(np.maximum(t_days - cp, 0.0))  # slope change
+        for period, order in self._active:
+            for k in range(1, order + 1):
+                ang = 2 * np.pi * k * t_days / period
+                cols.append(np.sin(ang))
+                cols.append(np.cos(ang))
+        return np.column_stack(cols)
+
+    def fit(self, ds: np.ndarray, y: np.ndarray) -> "_NumpyProphet":
+        import pandas as pd
+        ds = pd.to_datetime(pd.Series(ds))
+        order = np.argsort(ds.to_numpy())  # prophet sorts history too
+        ds = ds.iloc[order].reset_index(drop=True)
+        y = np.asarray(y, np.float64)[order]
+        self._t0 = ds.iloc[0]
+        t = (ds - self._t0).dt.total_seconds().to_numpy() / 86400.0
+        span = t[-1] - t[0]
+        # Prophet-style auto seasonality: enable a component if the
+        # history covers >= 2 of its periods OR it was explicitly forced
+        self._active = [po for name, po in self.orders.items()
+                        if po[1] > 0
+                        and (span >= 2 * po[0]
+                             or name in self.force_seasons)]
+        hi = t[0] + self.changepoint_range * span
+        self._cps = np.linspace(t[0], hi, self.n_changepoints + 2)[1:-1]
+        X = self._design(t)
+        self._y_mean, self._y_scale = y.mean(), max(y.std(), 1e-9)
+        ys = (y - self._y_mean) / self._y_scale
+        # ridge: no penalty on intercept/base slope, L2 on changepoint
+        # deltas (Prophet's Laplace prior, L2 here) and seasonal coefs
+        pen = np.zeros(X.shape[1])
+        pen[2:2 + len(self._cps)] = self.reg
+        pen[2 + len(self._cps):] = 1.0
+        A = X.T @ X + np.diag(pen)
+        self._beta = np.linalg.solve(A, X.T @ ys)
+        return self
+
+    def predict(self, ds_future: np.ndarray) -> np.ndarray:
+        import pandas as pd
+        ds = pd.to_datetime(pd.Series(ds_future))
+        t = (ds - self._t0).dt.total_seconds().to_numpy() / 86400.0
+        yhat = self._design(t) @ self._beta
+        return yhat * self._y_scale + self._y_mean
+
+
+def _prophet_kwargs_to_numpy(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Translate standard Prophet constructor kwargs for _NumpyProphet;
+    unknown/unsupported kwargs raise a clear error instead of a TypeError
+    deep inside fit."""
+    season_default = {"yearly": 10, "weekly": 3, "daily": 4}
+    out: Dict[str, Any] = {}
+    for k, v in kwargs.items():
+        if k in ("n_changepoints", "changepoint_range"):
+            out[k] = v
+        elif k in ("yearly_seasonality", "weekly_seasonality",
+                   "daily_seasonality"):
+            name = k.split("_")[0]
+            if v == "auto":
+                continue  # keep the span-based auto default
+            order = (season_default[name] if v is True
+                     else 0 if v is False else int(v))
+            out[f"{name}_order"] = order
+            if order > 0:  # explicit request overrides the span gate
+                out.setdefault("force_seasons", [])
+                out["force_seasons"].append(name)
+        else:
+            raise ValueError(
+                f"prophet kwarg {k!r} is not supported by the numpy "
+                "fallback backend (supported: n_changepoints, "
+                "changepoint_range, yearly/weekly/daily_seasonality); "
+                "install prophet for the full parameter surface")
+    return out
+
+
+class ProphetForecaster:
+    """Prophet when importable, else a pure-numpy decomposable-model
+    backend (piecewise-linear trend + Fourier seasonality, ridge-fitted) —
+    it always executes (reference: chronos/model/prophet.py wrapped the
+    optional prophet package)."""
+
+    def __init__(self, backend: str = "auto", **prophet_kwargs: Any):
+        if backend not in ("auto", "prophet", "numpy"):
+            raise ValueError(
+                f"backend must be 'auto', 'prophet' or 'numpy', got "
+                f"{backend!r}")
+        if backend == "auto":
+            try:
+                from prophet import Prophet  # noqa: F401
+                backend = "prophet"
+            except ImportError:
+                backend = "numpy"
+        if backend == "prophet":
+            try:
+                from prophet import Prophet  # noqa: F401
+            except ImportError as e:
+                raise ImportError(
+                    "backend='prophet' requires the optional 'prophet' "
+                    "package (use backend='auto'/'numpy' for the built-in "
+                    "fallback)") from e
+        self.backend = backend
         self.kwargs = prophet_kwargs
+        if backend == "numpy":
+            # fail at construction, not deep inside fit
+            _prophet_kwargs_to_numpy(prophet_kwargs)
         self._m = None
+        self._last_ds = None
 
     def fit(self, df) -> "ProphetForecaster":
-        from prophet import Prophet
-        self._m = Prophet(**self.kwargs)
-        self._m.fit(df)
+        """``df``: Prophet-convention DataFrame with ``ds`` and ``y``."""
+        import pandas as pd
+        if self.backend == "prophet":
+            from prophet import Prophet
+            self._m = Prophet(**self.kwargs)
+            self._m.fit(df)
+        else:
+            kw = _prophet_kwargs_to_numpy(self.kwargs)
+            self._m = _NumpyProphet(**kw).fit(
+                df["ds"].to_numpy(), df["y"].to_numpy())
+        self._last_ds = pd.to_datetime(df["ds"]).max()
         return self
 
     def predict(self, horizon: int = 1, freq: str = "D"):
-        future = self._m.make_future_dataframe(periods=horizon, freq=freq)
-        return self._m.predict(future).tail(horizon)
+        import pandas as pd
+        if self._m is None:
+            raise ValueError("fit first")
+        if self.backend == "prophet":
+            future = self._m.make_future_dataframe(periods=horizon,
+                                                   freq=freq)
+            return self._m.predict(future).tail(horizon)
+        future_ds = pd.date_range(self._last_ds, periods=horizon + 1,
+                                  freq=freq)[1:]
+        yhat = self._m.predict(future_ds.to_numpy())
+        return pd.DataFrame({"ds": future_ds, "yhat": yhat})

@@ -13,10 +13,27 @@ epoch; ``read_scalar`` gives programmatic access the way the reference's
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
+
+
+# Close leaked writers while the interpreter is fully alive: the backend
+# writer owns background threads whose teardown during interpreter
+# shutdown is unsafe.
+_live_writers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _close_all_writers() -> None:
+    for w in list(_live_writers):
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001 — best-effort shutdown
+            pass
 
 
 class SummaryWriter:
@@ -26,15 +43,20 @@ class SummaryWriter:
         self._path = os.path.join(self.log_dir, "scalars.jsonl")
         self._file = open(self._path, "a")
         self._tb = self._try_tensorboard()
+        _live_writers.add(self)
 
     def _try_tensorboard(self):
+        # torch's writer first: it uses a background THREAD.  tensorboardX
+        # spawns a multiprocessing child — forking a process that already
+        # carries JAX/TF threads aborts intermittently (absl/grpc mutexes
+        # held across fork), which took out whole test-suite runs.
         try:
-            from tensorboardX import SummaryWriter as TBWriter  # type: ignore
+            from torch.utils.tensorboard import SummaryWriter as TBWriter
             return TBWriter(self.log_dir)
         except Exception:
             pass
         try:
-            from torch.utils.tensorboard import SummaryWriter as TBWriter
+            from tensorboardX import SummaryWriter as TBWriter  # type: ignore
             return TBWriter(self.log_dir)
         except Exception:
             return None

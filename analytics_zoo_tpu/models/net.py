@@ -206,11 +206,11 @@ class Net:
         model = model_or_path
         if isinstance(model, str):
             model = tf.keras.models.load_model(model)
-        layers = [l for l in model.layers
-                  if type(l).__name__ != "InputLayer"]
         if not isinstance(model, tf.keras.Sequential):
             # functional graph (branches/merges): walk the config DAG
             return _load_keras_functional(model)
+        layers = [l for l in model.layers
+                  if type(l).__name__ != "InputLayer"]
         stages: List[Tuple[str, Module]] = []
         params: Dict[str, Any] = {}
         state: Dict[str, Any] = {}

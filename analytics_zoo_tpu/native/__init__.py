@@ -10,12 +10,14 @@ no compiler is available; ``NativeQueue.is_native`` reports which is active.
 
 from __future__ import annotations
 
+import atexit
 import ctypes
 import logging
 import os
 import queue as pyqueue
 import subprocess
 import threading
+import weakref
 from typing import Optional, Tuple
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -80,6 +82,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return lib
 
 
+# Every live queue, closed from an atexit hook: worker threads blocked in
+# push/pop must wake and exit while the interpreter is still fully alive —
+# a daemon thread returning from the (GIL-released) native call during
+# interpreter teardown is a "Fatal Python error" crash.
+_live_queues: "weakref.WeakSet[NativeQueue]" = weakref.WeakSet()
+
+
+@atexit.register
+def _close_all_queues() -> None:
+    for q in list(_live_queues):
+        try:
+            q.close()
+        except Exception:  # noqa: BLE001 — best-effort shutdown
+            pass
+
+
 class NativeQueue:
     """Bounded MPMC byte queue; C++-backed when the native lib builds."""
 
@@ -93,6 +111,7 @@ class NativeQueue:
             self._pyq = pyqueue.Queue(maxsize=max_items or 0)
             self.is_native = False
         self._closed = False
+        _live_queues.add(self)
 
     # -- ops ------------------------------------------------------------------
 

@@ -300,8 +300,11 @@ class BatchNormalization(Module):
         mean_run = scope.variable("mean", lambda: jnp.zeros((dim,)))
         var_run = scope.variable("var", lambda: jnp.ones((dim,)))
         if scope.training:
-            mean = x.mean(axis=reduce_axes)
-            var = x.var(axis=reduce_axes)
+            # statistics in f32 (bf16 accumulation over B*H*W loses too
+            # much), state stays f32
+            xf = x.astype(jnp.float32)
+            mean = xf.mean(axis=reduce_axes)
+            var = xf.var(axis=reduce_axes)
             m = self.momentum
             scope.put_variable("mean", m * mean_run + (1 - m) * mean)
             scope.put_variable("var", m * var_run + (1 - m) * var)
@@ -309,15 +312,23 @@ class BatchNormalization(Module):
             mean, var = mean_run, var_run
         shape = [1] * x.ndim
         shape[self.axis] = dim
-        y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
-            var.reshape(shape) + self.epsilon)
+        # fold (mean, var, gamma, beta) into per-channel scale/shift (tiny
+        # [C] vectors) so the activation tensor sees ONE multiply-add; the
+        # multiply-add itself runs in f32 (x*inv can be huge for badly
+        # centered channels — doing it in bf16 loses the cancellation
+        # against shift) and XLA fuses the upcast/downcast into the same
+        # elementwise kernel
+        inv = jax.lax.rsqrt(var + self.epsilon)
         if self.scale:
-            y = y * scope.param("gamma", initializers.get("ones"), (dim,)
-                                ).reshape(shape)
+            inv = inv * scope.param("gamma", initializers.get("ones"),
+                                    (dim,))
+        shift = -mean * inv
         if self.center:
-            y = y + scope.param("beta", initializers.get("zeros"), (dim,)
-                                ).reshape(shape)
-        return y.astype(x.dtype)  # running stats are f32; keep compute dtype
+            shift = shift + scope.param("beta", initializers.get("zeros"),
+                                        (dim,))
+        y = (x.astype(jnp.float32) * inv.reshape(shape)
+             + shift.reshape(shape))
+        return y.astype(x.dtype)
 
 
 class LayerNormalization(Module):

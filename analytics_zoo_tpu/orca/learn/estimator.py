@@ -453,7 +453,9 @@ class ZooEstimator:
             if rem is not None:
                 totals = accumulate(totals,
                                     _pad_remainder(rem, feed, mesh), -1)
-            elif getattr(feed, "shuffle", False):
+            elif (getattr(feed, "shuffle", False)
+                  and feed.num_rows % getattr(feed, "_local_batch", 1)):
+                # rows WERE dropped and this feed can't reconstruct them
                 logger.warning(
                     "evaluate on a shuffled drop_remainder feed that cannot "
                     "reconstruct its dropped rows: metrics exclude the rows "

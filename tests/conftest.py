@@ -33,6 +33,19 @@ def _fresh_context():
     stop_orca_context()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_accumulated_state():
+    """Full-suite hygiene: 360+ tests in one process accumulate jit
+    executables and native-side state; unbounded growth intermittently
+    aborts the interpreter deep into the run (observed as 'Fatal Python
+    error: Aborted' inside a trace).  Clearing jax's caches per MODULE
+    bounds it at the cost of some recompiles."""
+    yield
+    import gc
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

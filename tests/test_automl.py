@@ -164,3 +164,56 @@ def test_autots_accepts_max_concurrent():
                            future_seq_len=2)
     pipeline = auto.fit(train, epochs=1, n_sampling=2, max_concurrent=2)
     assert pipeline is not None and len(auto.trials) == 2
+
+
+def test_autots_concurrent_trials_with_varied_lookback():
+    """Regression (r3 review): concurrent trials with DIFFERENT lookback
+    candidates must not corrupt each other's rolled windows."""
+    import numpy as np
+    import pandas as pd
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset
+
+    t_idx = pd.date_range("2024-01-01", periods=400, freq="h")
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"timestamp": t_idx,
+                       "value": np.sin(np.arange(400) / 10)
+                       + 0.05 * rng.normal(size=400)})
+    train, _, _ = TSDataset.from_pandas(df, dt_col="timestamp",
+                                        target_col="value",
+                                        with_split=True, test_ratio=0.1)
+    train.scale()
+    auto = AutoTSEstimator(model=["lstm"],
+                           past_seq_len=hp.choice([8, 16, 24]),
+                           future_seq_len=2)
+    pipeline = auto.fit(train, epochs=1, n_sampling=4, max_concurrent=3)
+    assert pipeline is not None
+    # every trial completed (a window-shape race raises inside fit)
+    assert all(t.status in ("done", "pruned") for t in auto.trials), \
+        [(t.status, t.error) for t in auto.trials]
+
+
+def test_fit_args_apply_to_preexisting_engine():
+    """Regression (r3 review): max_concurrent/scheduler on fit() must take
+    effect when an engine already exists (custom engine or second fit)."""
+    import numpy as np
+    from analytics_zoo_tpu.automl import AutoEstimator, hp
+    from analytics_zoo_tpu.automl.search import (ASHAScheduler,
+                                                 GridSearchEngine)
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+
+    init_orca_context("local")
+    eng = GridSearchEngine(metric_mode="min")
+    auto = AutoEstimator(lambda cfg: nn.Sequential([nn.Dense(2)]),
+                         loss="sparse_categorical_crossentropy",
+                         search_engine=eng)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 32).astype(np.int32)
+    sched = ASHAScheduler(metric_mode="min")
+    auto.fit((x, y), epochs=1, n_sampling=2,
+             search_space={"lr": hp.choice([1e-3, 1e-2])},
+             scheduler=sched, max_concurrent=2)
+    assert eng.max_concurrent == 2
+    assert eng.scheduler is sched

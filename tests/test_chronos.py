@@ -486,3 +486,79 @@ def test_arima_numpy_backend_rejects_seasonal_arma():
     with pytest.raises(NotImplementedError, match="statsmodels"):
         ARIMAForecaster(order=(1, 0, 0), seasonal_order=(1, 0, 0, 12),
                         backend="numpy").fit(np.arange(100.0))
+
+
+# -- Prophet: executable in this image via the numpy backend ------------------
+
+def test_prophet_numpy_backend_fits_trend_and_weekly_seasonality():
+    from analytics_zoo_tpu.chronos.forecaster import ProphetForecaster
+    rng = np.random.default_rng(0)
+    ds = pd.date_range("2023-01-01", periods=400, freq="D")
+    t = np.arange(400)
+    y = (0.5 * t                                  # trend
+         + 5.0 * np.sin(2 * np.pi * t / 7)        # weekly
+         + 0.3 * rng.normal(size=400))
+    f = ProphetForecaster(backend="numpy").fit(
+        pd.DataFrame({"ds": ds, "y": y}))
+    out = f.predict(horizon=14, freq="D")
+    assert list(out.columns[:2]) == ["ds", "yhat"]
+    t_fut = np.arange(400, 414)
+    want = 0.5 * t_fut + 5.0 * np.sin(2 * np.pi * t_fut / 7)
+    err = np.abs(out["yhat"].to_numpy() - want)
+    assert err.mean() < 1.0, err
+
+
+def test_prophet_auto_backend_always_executes():
+    from analytics_zoo_tpu.chronos.forecaster import ProphetForecaster
+    ds = pd.date_range("2024-01-01", periods=100, freq="D")
+    f = ProphetForecaster().fit(
+        pd.DataFrame({"ds": ds, "y": np.arange(100.0)}))
+    assert f.backend in ("numpy", "prophet")
+    out = f.predict(horizon=3, freq="D")
+    assert len(out) == 3
+
+
+def test_prophet_invalid_backend_rejected():
+    from analytics_zoo_tpu.chronos.forecaster import ProphetForecaster
+    with pytest.raises(ValueError, match="backend"):
+        ProphetForecaster(backend="stan")
+
+
+def test_prophet_numpy_backend_standard_kwargs_and_unsorted_ds():
+    """Regression (r3 review): Prophet-convention kwargs translate (or
+    reject clearly), and unsorted history is sorted like Prophet does."""
+    from analytics_zoo_tpu.chronos.forecaster import ProphetForecaster
+    rng = np.random.default_rng(0)
+    ds = pd.date_range("2023-01-01", periods=300, freq="D")
+    t = np.arange(300)
+    y = 0.5 * t + 5 * np.sin(2 * np.pi * t / 7) + 0.1 * rng.normal(size=300)
+    perm = rng.permutation(300)  # UNSORTED history
+    df = pd.DataFrame({"ds": ds[perm], "y": y[perm]})
+    f = ProphetForecaster(backend="numpy", weekly_seasonality=True,
+                          n_changepoints=10).fit(df)
+    out = f.predict(horizon=7, freq="D")
+    # future dates start after the true max date
+    assert out["ds"].iloc[0] > ds.max()
+    t_fut = np.arange(300, 307)
+    want = 0.5 * t_fut + 5 * np.sin(2 * np.pi * t_fut / 7)
+    assert np.abs(out["yhat"].to_numpy() - want).mean() < 1.5
+    with pytest.raises(ValueError, match="numpy"):
+        ProphetForecaster(backend="numpy", seasonality_mode="multiplicative")
+
+
+def test_prophet_numpy_explicit_seasonality_overrides_span_gate():
+    """Regression (r3 review): weekly_seasonality=True must fit the weekly
+    component even when the history covers < 2 weeks."""
+    from analytics_zoo_tpu.chronos.forecaster import ProphetForecaster
+    rng = np.random.default_rng(0)
+    ds = pd.date_range("2024-01-01", periods=10 * 24, freq="h")  # 10 days
+    t = np.arange(len(ds))
+    y = 3.0 * np.sin(2 * np.pi * t / (7 * 24)) + 0.05 * rng.normal(
+        size=len(t))
+    f = ProphetForecaster(backend="numpy", weekly_seasonality=True,
+                          n_changepoints=3).fit(
+        pd.DataFrame({"ds": ds, "y": y}))
+    out = f.predict(horizon=24, freq="h")
+    t_fut = np.arange(len(t), len(t) + 24)
+    want = 3.0 * np.sin(2 * np.pi * t_fut / (7 * 24))
+    assert np.abs(out["yhat"].to_numpy() - want).mean() < 0.7

@@ -67,3 +67,11 @@ def test_chronos_autots_example():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "test metrics:" in proc.stdout
     assert "reloaded prediction shape:" in proc.stdout
+
+
+def test_torch_import_example():
+    proc = _run("torch_import.py", "--epochs", "1", "--samples", "96",
+                "--batch-size", "32")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "validation:" in proc.stdout
+    assert "max |diff|" in proc.stdout

@@ -600,3 +600,33 @@ def test_load_tf_functional_input_order_from_spec():
     variables = net.init(jax.random.PRNGKey(0), xa, xb)
     got, _ = net.apply(variables, xa, xb)
     np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_fx_densenet_style_channel_concat():
+    """DenseNet-style 4-D channel concats (cat dim=1 on feature maps)
+    convert and match torch."""
+    init_orca_context("local")
+
+    class DenseBlock(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(3, 4, 3, padding=1)
+            self.c2 = torch.nn.Conv2d(7, 4, 3, padding=1)
+            self.pool = torch.nn.AdaptiveAvgPool2d(1)
+            self.fc = torch.nn.Linear(11, 2)
+
+        def forward(self, x):
+            h1 = torch.relu(self.c1(x))
+            x1 = torch.cat([x, h1], dim=1)          # 3 + 4 = 7 channels
+            h2 = torch.relu(self.c2(x1))
+            x2 = torch.cat([x1, h2], dim=1)         # 7 + 4 = 11
+            p = self.pool(x2)
+            return self.fc(torch.flatten(p, 1))
+
+    m = DenseBlock().eval()
+    x = np.random.default_rng(4).normal(size=(2, 3, 8, 8)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = m(torch.as_tensor(x)).numpy()
+    net = Net.load_torch_graph(m, x)
+    np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)

@@ -350,3 +350,21 @@ def test_extended_loss_functions():
     np.testing.assert_allclose(
         poisson, float(np.mean([2 - np.log(2), 0.5 - np.log(0.5)])),
         rtol=1e-5)
+
+
+def test_batchnorm_bf16_badly_centered_channels():
+    """Regression (r3 review): BN on bf16 activations with |mean| >> std
+    must normalize in f32 — bf16 x*scale would drown the signal."""
+    rng = np.random.default_rng(0)
+    # mean >> std but still representable in bf16 (quantum at 10 is
+    # ~0.0625 < std): input keeps its signal, so any remaining error
+    # comes from the normalize math itself
+    x32 = (10.0 + 1.0 * rng.normal(size=(64, 8))).astype(np.float32)
+    bn = nn.BatchNormalization(momentum=0.0, epsilon=1e-5)
+    v = bn.init(jax.random.PRNGKey(0), jnp.asarray(x32), training=True)
+    out16, _ = bn.apply(v, jnp.asarray(x32, jnp.bfloat16), training=True)
+    out32, _ = bn.apply(v, jnp.asarray(x32), training=True)
+    corr = np.corrcoef(np.asarray(out16, np.float32).ravel(),
+                       np.asarray(out32).ravel())[0, 1]
+    assert corr > 0.99, corr
+    assert float(np.abs(np.asarray(out32).mean())) < 1e-3
