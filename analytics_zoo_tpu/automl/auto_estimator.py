@@ -46,7 +46,7 @@ class AutoEstimator:
             batch_size: Any = 32, n_sampling: int = 4,
             search_space: Optional[Dict[str, Any]] = None,
             scheduler: Optional[ASHAScheduler] = None,
-            max_concurrent: int = 1,
+            max_concurrent: Optional[int] = None,
             seed: int = 0) -> "AutoEstimator":
         """Search; then keep the best trained estimator.
 
@@ -65,10 +65,11 @@ class AutoEstimator:
             scheduler = ASHAScheduler(metric_mode=self.metric_mode)
         engine = self.engine or RandomSearchEngine(
             metric_mode=self.metric_mode, scheduler=scheduler,
-            max_concurrent=max_concurrent, seed=seed)
+            max_concurrent=max_concurrent or 1, seed=seed)
         # fit()'s arguments must take effect on a pre-existing engine too
-        # (custom search_engine, or a second fit() on the cached engine)
-        if max_concurrent != 1:
+        # (custom search_engine, or a second fit() on the cached engine);
+        # None = unspecified, an explicit 1 restores serial execution
+        if max_concurrent is not None:
             engine.max_concurrent = max_concurrent
         if scheduler is not None:
             engine.scheduler = scheduler

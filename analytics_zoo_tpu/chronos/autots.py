@@ -135,7 +135,7 @@ class AutoTSEstimator:
     def fit(self, data, validation_data=None, epochs: int = 2,
             batch_size: int = 32, n_sampling: int = 4,
             scheduler: Optional[ASHAScheduler] = None,
-            max_concurrent: int = 1) -> TSPipeline:
+            max_concurrent: Optional[int] = None) -> TSPipeline:
         """``data``: a TSDataset (re-rolled per lookback candidate) or a
         rolled (x, y) tuple.  ``max_concurrent``: parallel trials (thread
         pool; XLA releases the GIL during compute)."""
@@ -147,7 +147,7 @@ class AutoTSEstimator:
             space["past_seq_len"] = self.past_seq_len
         engine = RandomSearchEngine(metric_mode=self.metric_mode,
                                     scheduler=scheduler,
-                                    max_concurrent=max_concurrent,
+                                    max_concurrent=max_concurrent or 1,
                                     seed=self.seed)
 
         import threading
@@ -178,9 +178,15 @@ class AutoTSEstimator:
         def trial_fn(config, report):
             fc, (x, y), _ = make(config)
             if validation_data is not None:
-                vx, vy = (validation_data.to_numpy()
-                          if hasattr(validation_data, "to_numpy")
-                          else validation_data)
+                if isinstance(validation_data, TSDataset):
+                    # re-roll per trial: each candidate lookback needs its
+                    # own validation windows (same lock as `data`)
+                    with roll_lock:
+                        validation_data.roll(fc.past_seq_len,
+                                             self.future_seq_len)
+                        vx, vy = validation_data.to_numpy()
+                else:
+                    vx, vy = validation_data
             else:
                 n_val = max(1, len(x) // 5)
                 vx, vy = x[-n_val:], y[-n_val:]

@@ -562,3 +562,30 @@ def test_prophet_numpy_explicit_seasonality_overrides_span_gate():
     t_fut = np.arange(len(t), len(t) + 24)
     want = 3.0 * np.sin(2 * np.pi * t_fut / (7 * 24))
     assert np.abs(out["yhat"].to_numpy() - want).mean() < 0.7
+
+
+def test_autots_tsdataset_validation_rerolled_per_lookback():
+    """Regression (r3 review): a TSDataset validation_data must be
+    re-rolled per trial when lookback is a search dimension."""
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.chronos import AutoTSEstimator, TSDataset
+
+    t_idx = pd.date_range("2024-01-01", periods=400, freq="h")
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"timestamp": t_idx,
+                       "value": np.sin(np.arange(400) / 10)
+                       + 0.05 * rng.normal(size=400)})
+    train, _, val = TSDataset.from_pandas(df, dt_col="timestamp",
+                                          target_col="value",
+                                          with_split=True, val_ratio=0.2,
+                                          test_ratio=0.1)
+    train.scale()
+    val.scale(train.scaler, fit=False)
+    auto = AutoTSEstimator(model=["lstm"],
+                           past_seq_len=hp.choice([8, 16]),
+                           future_seq_len=2)
+    pipeline = auto.fit(train, validation_data=val, epochs=1,
+                        n_sampling=3, max_concurrent=2)
+    assert pipeline is not None
+    assert all(t.status in ("done", "pruned") for t in auto.trials), \
+        [(t.status, t.error) for t in auto.trials]

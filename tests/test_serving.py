@@ -302,3 +302,66 @@ def test_update_model_hot_swap():
         after = out_q.query(uid2, timeout=30)
         np.testing.assert_allclose(after, np.full(4, 2.0), rtol=1e-6)
         q.close()
+
+
+def test_inference_model_int8_weight_quantization():
+    """Weight-only int8 serving (reference: doLoadOpenVINOInt8): large
+    float params are stored int8 + per-channel scales (4x smaller), and
+    predictions stay close to the f32 model."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
+                                                           _Q_MARKER)
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(256, activation="relu"),
+                           nn.Dense(128, activation="relu"),
+                           nn.Dense(10)])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    ref = InferenceModel().load(model, variables)
+    q = InferenceModel().load(model, variables, dtype="int8")
+    out_ref = np.asarray(ref.predict(x), np.float32)
+    out_q = np.asarray(q.predict(x), np.float32)
+    # int8 weights + bf16 activations: small but nonzero error
+    denom = np.maximum(np.abs(out_ref), 1.0)
+    assert np.max(np.abs(out_q - out_ref) / denom) < 0.08
+
+    # big kernels really stored int8; small leaves (biases) stay float
+    p = q._variables["params"]
+    layer0 = p[next(iter(p))]  # first Dense layer's params
+    k0 = layer0["kernel"]
+    assert isinstance(k0, dict) and _Q_MARKER in k0
+    assert k0["q"].dtype == jnp.int8
+    assert not isinstance(layer0["bias"], dict)
+
+
+def test_inference_model_reload_and_int8_dtype_spellings():
+    """Regression (r3 review): reloading clears stale executables, and
+    jnp.int8/np.int8 route to weight-only quantization (NOT a float->int
+    cast that zeroes weights)."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(128, activation="relu"),
+                           nn.Dense(4)])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    im = InferenceModel()
+    im.load(model, variables)
+    ref = np.asarray(im.predict(x), np.float32)
+    # reload with a different variable STRUCTURE (int8 markers) — must
+    # recompile, not crash on the stale executable
+    im.load(model, variables, dtype=jnp.int8)
+    out = np.asarray(im.predict(x), np.float32)
+    assert not np.allclose(out, 0.0)  # int8 CAST would zero the weights
+    denom = np.maximum(np.abs(ref), 1.0)
+    assert np.max(np.abs(out - ref) / denom) < 0.08
