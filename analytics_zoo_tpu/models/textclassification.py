@@ -19,20 +19,50 @@ from .common import ZooModel
 class TextClassifier(ZooModel):
     def __init__(self, class_num: int, vocab_size: int = 20000,
                  token_length: int = 200, sequence_length: int = 500,
-                 encoder: str = "cnn", encoder_output_dim: int = 256):
+                 encoder: str = "cnn", encoder_output_dim: int = 256,
+                 embedding_weights=None, embedding_trainable: bool = False,
+                 embedding_shape=None):
+        """``embedding_weights``: optional pre-trained [vocab, dim] table
+        (e.g. ``nn.WordEmbedding.from_glove(...).weights``) — the
+        reference's TextClassifier took a GloVe embedding file the same
+        way; frozen unless ``embedding_trainable``.  ``embedding_shape``
+        is the save/load round-trip of the table's shape (the values
+        themselves travel in the saved variables)."""
         super().__init__()
+        import numpy as np
+        if embedding_weights is not None:
+            embedding_weights = np.asarray(embedding_weights, np.float32)
+            if embedding_weights.shape[0] != vocab_size:
+                raise ValueError(
+                    f"embedding_weights has {embedding_weights.shape[0]} "
+                    f"rows but vocab_size={vocab_size}; out-of-range ids "
+                    "would silently clamp to the last row")
+            embedding_shape = list(embedding_weights.shape)
+        elif embedding_shape is not None:
+            # loading path: architecture only — saved variables carry the
+            # actual table values
+            embedding_weights = np.zeros(tuple(embedding_shape), np.float32)
         self._config = dict(class_num=class_num, vocab_size=vocab_size,
                             token_length=token_length,
                             sequence_length=sequence_length, encoder=encoder,
-                            encoder_output_dim=encoder_output_dim)
+                            encoder_output_dim=encoder_output_dim,
+                            embedding_shape=embedding_shape,
+                            embedding_trainable=embedding_trainable)
         for k, v in self._config.items():
             setattr(self, k, v)
+        self.embedding_weights = embedding_weights
         if encoder not in ("cnn", "lstm", "gru"):
             raise ValueError(f"unknown encoder {encoder!r}")
 
     def forward(self, scope, ids):
-        x = scope.child(nn.Embedding(self.vocab_size, self.token_length),
-                        ids, name="embed")
+        if self.embedding_weights is not None:
+            x = scope.child(
+                nn.WordEmbedding(self.embedding_weights,
+                                 trainable=self.embedding_trainable),
+                ids, name="embed")
+        else:
+            x = scope.child(nn.Embedding(self.vocab_size, self.token_length),
+                            ids, name="embed")
         if self.encoder == "cnn":
             h = scope.child(nn.Conv1D(self.encoder_output_dim, 5,
                                       activation="relu"), x, name="conv")

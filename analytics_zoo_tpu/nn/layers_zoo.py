@@ -311,6 +311,74 @@ class ActivityRegularization(Module):
         return x
 
 
+class WordEmbedding(Module):
+    """Pre-trained word embeddings, frozen by default (reference:
+    WordEmbedding — zoo keras layers; loaded GloVe txt files for the text
+    models).  ``weights``: [vocab, dim] array, or a GloVe-format txt path
+    via :meth:`from_glove`.
+
+    Freeze mechanism: a frozen table lives in the STATE collection (like
+    BatchNorm running stats), so the optimizer never sees it — gradient
+    stopping alone would not survive weight-decay optimizers like adamw,
+    whose decoupled decay shrinks parameters even at zero gradient."""
+
+    def __init__(self, weights: Any, trainable: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        import numpy as np
+        self.weights = np.asarray(weights, np.float32)
+        if self.weights.ndim != 2:
+            raise ValueError(
+                f"weights must be [vocab, dim], got {self.weights.shape}")
+        self.trainable = trainable
+
+    @staticmethod
+    def from_glove(path: str, word_index: dict,
+                   trainable: bool = False) -> "WordEmbedding":
+        """Build from a GloVe-format text file ("word v1 v2 ...": one token
+        per line) and a {word: idx} vocabulary (idx 0 = padding).  Words
+        missing from the file stay zero.  Malformed lines (multi-token
+        words, truncated tails, fastText "count dim" headers) are
+        skipped."""
+        import numpy as np
+        vectors = {}
+        dim = None
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 3:  # also skips fastText "count dim" header
+                    continue
+                try:
+                    vec = np.asarray(parts[1:], np.float32)
+                except ValueError:
+                    continue  # word containing spaces etc.
+                if dim is None:
+                    dim = len(vec)
+                if len(vec) != dim:
+                    continue  # truncated/odd line
+                vectors[parts[0]] = vec
+        if dim is None:
+            raise ValueError(f"no vectors found in {path}")
+        table = np.zeros((max(word_index.values()) + 1, dim), np.float32)
+        for word, idx in word_index.items():
+            v = vectors.get(word)
+            if v is not None:
+                table[idx] = v
+        return WordEmbedding(table, trainable=trainable)
+
+    def forward(self, scope: Scope, ids: jax.Array) -> jax.Array:
+        if self.trainable:
+            table = scope.param(
+                "embeddings", lambda rng, shape, dtype:
+                jnp.asarray(self.weights, dtype), self.weights.shape)
+        else:
+            # state, not params: invisible to the optimizer entirely
+            table = scope.variable(
+                "embeddings", lambda: jnp.asarray(self.weights))
+            table = jax.lax.stop_gradient(table)
+        return jnp.take(table, ids, axis=0)
+
+
 # -- normalization -------------------------------------------------------------
 
 class LRN2D(Module):

@@ -273,3 +273,41 @@ def test_convlstm2d_valid_padding():
         np.float32)
     _, out = run(nn.ConvLSTM2D(4, 3, padding="valid"), x)
     assert out.shape == (2, 6, 6, 4)
+
+
+def test_word_embedding_frozen_and_glove_loading(tmp_path):
+    """WordEmbedding: pretrained table, frozen by default (no grad), GloVe
+    txt loading with zero rows for OOV words."""
+    glove = tmp_path / "glove.txt"
+    glove.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    layer = nn.WordEmbedding.from_glove(
+        str(glove), {"hello": 1, "world": 2, "unseen": 3})
+    ids = np.asarray([[1, 2, 3, 0]])
+    variables, out = run(layer, ids)
+    np.testing.assert_allclose(out[0, 0], [1, 2, 3])
+    np.testing.assert_allclose(out[0, 1], [4, 5, 6])
+    np.testing.assert_allclose(out[0, 2], 0.0)  # OOV stays zero
+    # frozen: the table lives in STATE (outside the optimizer), not params
+    assert "embeddings" in variables["state"]
+    assert "embeddings" not in variables["params"]
+    # trainable=True: a param with flowing gradients
+    t = nn.WordEmbedding(np.ones((4, 3), np.float32), trainable=True)
+    vt = t.init(RNG, jnp.asarray(ids))
+    gt = jax.grad(lambda v: jnp.sum(t.apply(v, jnp.asarray(ids))[0] ** 2))(vt)
+    assert float(np.abs(np.asarray(
+        gt["params"]["embeddings"])).max()) > 0.0
+
+
+def test_word_embedding_glove_skips_malformed_lines(tmp_path):
+    """Regression (r3 review): multi-token words, truncated lines and
+    fastText headers must be skipped, not crash or poison dim."""
+    glove = tmp_path / "messy.txt"
+    glove.write_text("999994 300\n"          # fastText header
+                     "hello 1.0 2.0 3.0\n"
+                     ". . . 9.9 9.9 9.9\n"   # word containing spaces
+                     "world 4.0 5.0 6.0\n"
+                     "trunc 7.0\n")          # truncated tail
+    layer = nn.WordEmbedding.from_glove(
+        str(glove), {"hello": 1, "world": 2})
+    np.testing.assert_allclose(layer.weights[1], [1, 2, 3])
+    np.testing.assert_allclose(layer.weights[2], [4, 5, 6])

@@ -284,3 +284,42 @@ def test_tsdataset_to_feed():
     batch = next(feed.epoch(get_mesh(), 0))
     assert batch["x"].shape == (16, 12, 1)
     assert batch["y"].shape == (16, 2, 1)
+
+
+def test_text_classifier_pretrained_embeddings_frozen(tmp_path):
+    """TextClassifier with a pretrained embedding table (reference took a
+    GloVe file): frozen even under adamw's decoupled weight decay, and
+    the frozen semantics survive save_model/load_model."""
+    import jax
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.models import TextClassifier, ZooModel
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    rng = np.random.default_rng(0)
+    table = rng.normal(size=(50, 16)).astype(np.float32)
+    with pytest.raises(ValueError, match="vocab_size"):
+        TextClassifier(class_num=2, vocab_size=99, embedding_weights=table)
+    m = TextClassifier(class_num=2, vocab_size=50,
+                       embedding_weights=table, encoder="cnn",
+                       encoder_output_dim=8)
+    ids = rng.integers(0, 50, (32, 12)).astype(np.int32)
+    y = rng.integers(0, 2, 32).astype(np.int32)
+    # adamw: weight decay would shrink a merely-stop_gradient'd table
+    est = Estimator.from_keras(m, loss="sparse_categorical_crossentropy",
+                               optimizer="adamw", learning_rate=5e-3)
+    est.fit((ids, y), epochs=2, batch_size=16, verbose=False)
+    trained = np.asarray(
+        jax.device_get(est._ts["state"])["embed"]["embeddings"])
+    np.testing.assert_array_equal(trained, table)  # frozen, not decayed
+    # save/load round-trip keeps the pretrained-frozen architecture
+    m.set_estimator(est)
+    path = m.save_model(str(tmp_path / "tc"))
+    m2 = ZooModel.load_model(path)
+    assert m2.embedding_shape == [50, 16]
+    m2.compile_with_loaded(loss="sparse_categorical_crossentropy")
+    out = m2.predict(ids[:4])
+    assert np.asarray(out).shape == (4, 2)
+    # the loaded model's frozen table carries the pretrained values
+    out_orig = m.predict(ids[:4])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_orig),
+                               atol=1e-5)
