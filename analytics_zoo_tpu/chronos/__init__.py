@@ -13,11 +13,13 @@ from .forecaster import (LSTMForecaster, Seq2SeqForecaster, TCNForecaster,
 from .mtnet import MTNetForecaster
 from .tcmf import TCMFForecaster
 from .detector import AEDetector, DBScanDetector, ThresholdDetector
-from .autots import AutoTSEstimator, TSPipeline
+from .autots import (AutoLSTM, AutoSeq2Seq, AutoTCN,
+                     AutoTSEstimator, TSPipeline)
 from .experimental import XShardsTSDataset
 
 __all__ = ["TSDataset", "XShardsTSDataset", "LSTMForecaster", "Seq2SeqForecaster",
            "TCNForecaster", "MTNetForecaster", "TCMFForecaster",
            "ARIMAForecaster", "ProphetForecaster",
            "AEDetector", "DBScanDetector", "ThresholdDetector",
-           "AutoTSEstimator", "TSPipeline"]
+           "AutoTSEstimator", "TSPipeline",
+           "AutoLSTM", "AutoTCN", "AutoSeq2Seq"]

@@ -226,3 +226,32 @@ class AutoTSEstimator:
         if self.best_config is None:
             raise ValueError("call fit() first")
         return dict(self.best_config)
+
+
+class _SingleModelAuto(AutoTSEstimator):
+    """Per-model HPO wrapper (reference: AutoLSTM/AutoTCN/AutoSeq2Seq in
+    pyzoo/zoo/chronos/autots/model/) — an AutoTSEstimator with the model
+    family fixed, searching only hyperparameters (+ lookback if given as
+    a space)."""
+
+    MODEL_NAME: str = ""
+
+    def __init__(self, **kwargs: Any):
+        if "model" in kwargs:
+            raise ValueError(
+                f"{type(self).__name__} searches the "
+                f"{self.MODEL_NAME!r} family only; use AutoTSEstimator "
+                "to search across model types")
+        super().__init__(model=[self.MODEL_NAME], **kwargs)
+
+
+class AutoLSTM(_SingleModelAuto):
+    MODEL_NAME = "lstm"
+
+
+class AutoTCN(_SingleModelAuto):
+    MODEL_NAME = "tcn"
+
+
+class AutoSeq2Seq(_SingleModelAuto):
+    MODEL_NAME = "seq2seq"

@@ -216,5 +216,16 @@ class TSDataset:
         x, y = self.to_numpy()
         return DataFeed.from_arrays(x, y, batch_size, shuffle=shuffle, **kw)
 
+    def to_torch_data_loader(self, batch_size: int = 32,
+                             shuffle: bool = True):
+        """Rolled windows as a ``torch.utils.data.DataLoader`` (reference:
+        TSDataset.to_torch_data_loader) — for porting torch training loops
+        unchanged; native training uses :meth:`to_feed`."""
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+        x, y = self.to_numpy()
+        ds = TensorDataset(torch.as_tensor(x), torch.as_tensor(y))
+        return DataLoader(ds, batch_size=batch_size, shuffle=shuffle)
+
     def to_pandas(self) -> pd.DataFrame:
         return self.df.copy()

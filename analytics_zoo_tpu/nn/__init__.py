@@ -28,7 +28,7 @@ from .layers_zoo import (ActivityRegularization, AddConstant, AlphaDropout,
                          Exp, HardShrink, Identity, LocallyConnected2D, Log,
                          LRN2D, MulConstant, Negative, Power, Scale,
                          SeparableConv1D, Softmax, SoftShrink, Sqrt, Square,
-                         Threshold, WordEmbedding)
+                         Threshold, WordEmbedding, Merge, merge)
 from .functional import Input, Model, SymbolicTensor
 from .module import Module, Scope, param_count
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
@@ -73,7 +73,7 @@ __all__ = [
     "SeparableConv1D", "AlphaDropout", "Softmax", "ActivityRegularization",
     "LRN2D", "Cos", "Identity", "Exp", "Log", "Sqrt", "Square", "Power",
     "Negative", "AddConstant", "MulConstant", "Scale", "Threshold",
-    "HardShrink", "SoftShrink", "WordEmbedding",
+    "HardShrink", "SoftShrink", "WordEmbedding", "Merge", "merge",
     # keras-1 naming aliases
     "Convolution1D", "Convolution2D", "Convolution3D", "Deconvolution2D",
     "Deconvolution3D", "AtrousConvolution1D", "AtrousConvolution2D",

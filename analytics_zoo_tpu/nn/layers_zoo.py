@@ -518,3 +518,51 @@ class SoftShrink(Module):
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         return (jnp.sign(x)
                 * jnp.maximum(jnp.abs(x) - self.lam, 0.0)).astype(x.dtype)
+
+
+# -- keras-1 merge API ---------------------------------------------------------
+
+class Merge(Module):
+    """keras-1 ``Merge(mode=...)`` layer over a LIST of inputs (reference:
+    the zoo keras-1 API's merge modes: sum/mul/ave/max/min/concat/dot/cos).
+    A thin dispatcher over the canonical merge layers (Add, Multiply,
+    Dot, ...) so keras-1-era scripts port verbatim."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 dot_axes: Any = -1, name: Optional[str] = None):
+        super().__init__(name)
+        from .layers import Add, Concatenate, Multiply
+        from .layers_extra import Average, Dot, Maximum, Minimum
+        mode = mode.lower()
+        table = {"sum": Add, "mul": Multiply, "ave": Average,
+                 "max": Maximum, "min": Minimum}
+        if mode in table:
+            self.impl: Module = table[mode]()
+        elif mode == "concat":
+            self.impl = Concatenate(axis=concat_axis)
+        elif mode == "dot":
+            # keras-1 merge(mode='dot', dot_axes=...) == batch_dot
+            self.impl = Dot(axes=dot_axes)
+        elif mode == "cos":
+            self.impl = Cos()
+        else:
+            raise ValueError(f"unknown merge mode {mode!r}")
+        self.mode = mode
+
+    def forward(self, scope: Scope, inputs: Sequence[jax.Array]) -> jax.Array:
+        out = scope.child(self.impl, list(inputs), name=self.mode)
+        if self.mode == "dot" and out.ndim == 1:
+            out = out[:, None]  # keras batch_dot keeps >= 2 dims
+        return out
+
+
+def merge(inputs: Sequence[Any], mode: str = "sum",
+          concat_axis: int = -1, dot_axes: Any = -1):
+    """keras-1 functional spelling: ``merge([a, b], mode="sum")`` — works
+    on SymbolicTensors inside an ``nn.Model`` graph and on arrays."""
+    layer = Merge(mode=mode, concat_axis=concat_axis, dot_axes=dot_axes)
+    from .functional import _contains_symbolic
+    if _contains_symbolic(list(inputs)):
+        return layer(inputs)
+    out, _ = layer.apply({"params": {}, "state": {}}, list(inputs))
+    return out

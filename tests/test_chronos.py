@@ -589,3 +589,36 @@ def test_autots_tsdataset_validation_rerolled_per_lookback():
     assert pipeline is not None
     assert all(t.status in ("done", "pruned") for t in auto.trials), \
         [(t.status, t.error) for t in auto.trials]
+
+
+def test_tsdataset_to_torch_data_loader():
+    torch = pytest.importorskip("torch")
+    from analytics_zoo_tpu.chronos import TSDataset
+    df = pd.DataFrame({"timestamp": pd.date_range("2024-01-01", periods=60,
+                                                  freq="h"),
+                       "value": np.arange(60.0)})
+    ts = TSDataset.from_pandas(df, dt_col="timestamp", target_col="value")
+    ts.roll(lookback=12, horizon=2)
+    loader = ts.to_torch_data_loader(batch_size=8, shuffle=False)
+    xb, yb = next(iter(loader))
+    assert tuple(xb.shape) == (8, 12, 1) and tuple(yb.shape) == (8, 2, 1)
+    assert isinstance(loader, torch.utils.data.DataLoader)
+
+
+def test_auto_single_model_wrappers():
+    from analytics_zoo_tpu.chronos import AutoLSTM, TSDataset
+    t_idx = pd.date_range("2024-01-01", periods=300, freq="h")
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"timestamp": t_idx,
+                       "value": np.sin(np.arange(300) / 10)
+                       + 0.05 * rng.normal(size=300)})
+    train, _, _ = TSDataset.from_pandas(df, dt_col="timestamp",
+                                        target_col="value",
+                                        with_split=True, test_ratio=0.1)
+    train.scale()
+    with pytest.raises(ValueError, match="family"):
+        AutoLSTM(model="tcn", past_seq_len=12, future_seq_len=2)
+    auto = AutoLSTM(past_seq_len=12, future_seq_len=2)
+    pipeline = auto.fit(train, epochs=1, n_sampling=2)
+    assert pipeline is not None
+    assert all(t.config["model"] == "lstm" for t in auto.trials)

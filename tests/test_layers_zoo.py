@@ -311,3 +311,46 @@ def test_word_embedding_glove_skips_malformed_lines(tmp_path):
         str(glove), {"hello": 1, "world": 2})
     np.testing.assert_allclose(layer.weights[1], [1, 2, 3])
     np.testing.assert_allclose(layer.weights[2], [4, 5, 6])
+
+
+def test_merge_layer_all_modes():
+    """keras-1 Merge(mode=...) + merge() function parity."""
+    a = np.asarray([[1.0, 2.0]], np.float32)
+    b = np.asarray([[3.0, 5.0]], np.float32)
+    cases = {
+        "sum": a + b, "mul": a * b, "ave": (a + b) / 2,
+        "max": np.maximum(a, b), "min": np.minimum(a, b),
+        "concat": np.concatenate([a, b], -1),
+        "dot": np.sum(a * b, -1, keepdims=True),
+    }
+    for mode, want in cases.items():
+        layer = nn.Merge(mode=mode)
+        v = layer.init(RNG, [jnp.asarray(a), jnp.asarray(b)])
+        out, _ = layer.apply(v, [jnp.asarray(a), jnp.asarray(b)])
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-6,
+                                   err_msg=mode)
+    # cos mode (delegates to a child Cos layer)
+    cl = nn.Merge(mode="cos")
+    vc = cl.init(RNG, [jnp.asarray(a), jnp.asarray(b)])
+    outc, _ = cl.apply(vc, [jnp.asarray(a), jnp.asarray(b)])
+    want_cos = (np.sum(a * b, -1, keepdims=True)
+                / (np.linalg.norm(a, axis=-1, keepdims=True)
+                   * np.linalg.norm(b, axis=-1, keepdims=True)))
+    np.testing.assert_allclose(np.asarray(outc), want_cos, atol=1e-6)
+    # dot mode honors dot_axes via the axes-aware Dot layer
+    a3 = np.ones((1, 2, 3), np.float32)
+    b3 = np.ones((1, 2, 3), np.float32)
+    outd = nn.merge([jnp.asarray(a3), jnp.asarray(b3)], mode="dot",
+                    dot_axes=2)
+    assert np.asarray(outd).shape[0] == 1
+    # eager-array functional spelling
+    oute = nn.merge([jnp.asarray(a), jnp.asarray(b)], mode="ave")
+    np.testing.assert_allclose(np.asarray(oute), (a + b) / 2, atol=1e-6)
+    with pytest.raises(ValueError, match="merge mode"):
+        nn.Merge(mode="xor")
+    # functional spelling inside a graph
+    ia, ib = nn.Input((2,)), nn.Input((2,))
+    m = nn.Model([ia, ib], nn.merge([ia, ib], mode="sum"))
+    vv = m.init(RNG, jnp.asarray(a), jnp.asarray(b))
+    out, _ = m.apply(vv, jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), a + b, atol=1e-6)
