@@ -12,6 +12,16 @@ descent (both factors updated by optax inside one compiled step — the
 panel never leaves the device), and the basis dynamics reuse the chronos
 TCN trunk on the unified Estimator.  API parity: fit(x={"y": ndarray}),
 predict(horizon) → [n, horizon], save/load.
+
+Distributed fit/predict (the reference ran TCMF on Spark/Ray workers via
+Orca): the panel's SERIES dimension is sharded over the mesh's ``data``
+axis — y and the per-series factor F live row-sharded, the shared basis X
+replicated, and GSPMD inserts the psum for X's gradient.  ``fit`` also
+accepts an ``XShards`` of ``{"id", "y"}`` panels (the reference's
+distributed input form); ``predict`` then returns per-shard
+``{"id", "prediction"}`` XShards, as the reference's distributed TCMF did.
+The loss is mask-normalized so a padded/sharded run computes EXACTLY the
+single-host numbers (tests assert equality).
 """
 
 from __future__ import annotations
@@ -64,23 +74,48 @@ class TCMFForecaster:
     # -- factorization ---------------------------------------------------------
 
     def _factorize(self, y: np.ndarray) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.core import get_mesh
+
         n, t = y.shape
         k = self.rank
+        # series-dimension sharding over the mesh's data axis (the
+        # reference's distributed TCMF sharded series across workers);
+        # rows are zero-padded to the axis size and masked out of the loss,
+        # so the sharded numbers equal the single-host ones exactly
+        mesh = get_mesh()
+        dp = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+        pad = (-n) % dp
+        y_pad = (np.concatenate([y, np.zeros((pad, t), np.float32)])
+                 if pad else y)
+        mask = np.zeros((n + pad, 1), np.float32)
+        mask[:n] = 1.0
         rng = jax.random.PRNGKey(self.seed)
         rf, rx = jax.random.split(rng)
-        params = {"F": jax.random.normal(rf, (n, k)) * 0.1,
-                  "X": jax.random.normal(rx, (k, t)) * 0.1}
-        yd = jnp.asarray(y, jnp.float32)
+        f0 = jax.random.normal(rf, (n + pad, k)) * 0.1
+        params = {"F": f0, "X": jax.random.normal(rx, (k, t)) * 0.1}
+        yd = jnp.asarray(y_pad, jnp.float32)
+        maskd = jnp.asarray(mask)
+        if dp > 1:
+            row = NamedSharding(mesh, P("data", None))
+            rep = NamedSharding(mesh, P())
+            yd = jax.device_put(yd, row)
+            maskd = jax.device_put(maskd, row)
+            params = {"F": jax.device_put(params["F"], row),
+                      "X": jax.device_put(params["X"], rep)}
         tx = optax.adam(self.lr)
-        opt = tx.init(params)
+        opt = jax.jit(tx.init)(params)  # opt slots inherit param shardings
         lam = self.lam
+        denom_mse = float(n * t)
+        denom_f = float(n * k)
 
-        @jax.jit
         def step(params, opt):
             def loss_fn(p):
                 recon = p["F"] @ p["X"]
-                mse = jnp.mean((recon - yd) ** 2)
-                reg = lam * (jnp.mean(p["F"] ** 2) + jnp.mean(p["X"] ** 2))
+                mse = jnp.sum(((recon - yd) * maskd) ** 2) / denom_mse
+                reg = lam * (jnp.sum((p["F"] * maskd) ** 2) / denom_f
+                             + jnp.mean(p["X"] ** 2))
                 return mse + reg
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -100,15 +135,26 @@ class TCMFForecaster:
             return params, losses
 
         params, losses = run(params, opt)
-        self.F = np.asarray(params["F"])
+        self.F = np.asarray(params["F"])[:n]
         self.X = np.asarray(params["X"])
         self._factor_loss = float(losses[-1])
 
     # -- public API ------------------------------------------------------------
 
-    def fit(self, x: Dict[str, np.ndarray], val_len: int = 0,
+    def fit(self, x: Any, val_len: int = 0,
             epochs: int = 5, batch_size: int = 64) -> float:
-        """``x``: {"y": [n, T] panel}.  Returns the factorization loss."""
+        """``x``: {"y": [n, T] panel}, or an ``XShards`` whose shards are
+        such dicts (optionally with "id") — the reference's distributed
+        input form.  Returns the factorization loss."""
+        from analytics_zoo_tpu.data import XShards
+
+        self._shard_sizes = self._shard_ids = None
+        if isinstance(x, XShards):
+            parts = x.collect()
+            self._shard_sizes = [np.asarray(p["y"]).shape[0] for p in parts]
+            self._shard_ids = [p.get("id") for p in parts]
+            x = {"y": np.concatenate(
+                [np.asarray(p["y"], np.float32) for p in parts])}
         y = np.asarray(x["y"], np.float32)
         if y.ndim != 2:
             raise ValueError(f"y must be [n, T], got {y.shape}")
@@ -162,12 +208,27 @@ class TCMFForecaster:
         window0 = jnp.asarray(self.X.T[-self.tcn_lookback:],
                               jnp.float32)[None]           # [1, look, k]
         xf = np.asarray(self._roll(est._ts, window0, horizon)).T  # [k, h]
-        return self.F @ xf
+        preds = self.F @ xf
+        if getattr(self, "_shard_sizes", None):
+            # distributed-input parity: fit saw an XShards panel, so hand
+            # back per-shard {"id", "prediction"} shards
+            from analytics_zoo_tpu.data import XShards
+            out, off = [], 0
+            for size, ids in zip(self._shard_sizes, self._shard_ids):
+                shard = {"prediction": preds[off:off + size]}
+                if ids is not None:
+                    shard["id"] = ids
+                out.append(shard)
+                off += size
+            return XShards(out)
+        return preds
 
     def evaluate(self, target_value: Dict[str, np.ndarray],
                  metric=("mae",)) -> Dict[str, float]:
         y = np.asarray(target_value["y"], np.float32)
         pred = self.predict(horizon=y.shape[1])
+        if not isinstance(pred, np.ndarray):  # distributed-input mode
+            pred = np.concatenate([s["prediction"] for s in pred.collect()])
         err = pred - y
         out = {}
         for m in metric:
@@ -188,6 +249,13 @@ class TCMFForecaster:
         np.savez(os.path.join(path, "factors.npz"), F=self.F, X=self.X)
         with open(os.path.join(path, "config.json"), "w") as f:
             json.dump(self._config, f)
+        if getattr(self, "_shard_sizes", None):
+            # distributed-fit metadata: predict() must keep returning
+            # per-shard XShards after a save/load round trip
+            with open(os.path.join(path, "shards.json"), "w") as f:
+                json.dump({"sizes": self._shard_sizes,
+                           "ids": [list(i) if i is not None else None
+                                   for i in self._shard_ids]}, f)
         self._tcn_est.save(os.path.join(path, "tcn"))
         return path
 
@@ -198,6 +266,11 @@ class TCMFForecaster:
         fc = TCMFForecaster(**cfg)
         z = np.load(os.path.join(path, "factors.npz"))
         fc.F, fc.X = z["F"], z["X"]
+        shards_file = os.path.join(path, "shards.json")
+        if os.path.exists(shards_file):
+            with open(shards_file) as f:
+                meta = json.load(f)
+            fc._shard_sizes, fc._shard_ids = meta["sizes"], meta["ids"]
         fc._tcn_est = fc._make_tcn_estimator()
         fc._tcn_est.load(os.path.join(path, "tcn"))
         return fc

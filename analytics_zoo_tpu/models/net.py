@@ -633,6 +633,23 @@ def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
     }
     for names, fn in binops.items():
         if tname in names:
+            # a flattened operand is in NHWC-flat element order; a constant
+            # tensor operand (get_attr) kept torch's NCHW-flat order, so a
+            # non-scalar constant combined elementwise would silently
+            # misorder (same hazard _POSITIONAL_PARAM_KINDS guards for
+            # modules)
+            operands = [a for a in n.args[:2] if isinstance(a, fx.Node)]
+            if any(res(a) in flat_origin for a in operands):
+                for a in operands:
+                    if a.op == "get_attr":
+                        s = shp(a)
+                        if s is None or int(np.prod(s)) > 1:
+                            raise NotImplementedError(
+                                f"elementwise {tname} between a flattened "
+                                "NCHW feature map and a non-scalar constant "
+                                "tensor would need the constant reordered "
+                                "to NHWC-flat order, which is unsupported; "
+                                "use the escape hatch")
             propagate_flat()
             return node(fn, n.args[:2])
 
@@ -651,12 +668,23 @@ def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
                     n.args[:1])
 
     if tname in ("contiguous", "clone", "detach", "dropout"):
-        # dropout reaches here only as F.dropout(training=False) under
-        # .eval(); trace-time constant False makes it identity
-        if tname == "dropout" and n.kwargs.get("training", False):
-            raise NotImplementedError(
-                "F.dropout(training=True) inside forward has no converted "
-                "equivalent; use nn.Dropout modules instead")
+        # F.dropout converts to identity ONLY when its training flag is a
+        # trace-time-constant False (e.g. `F.dropout(x, p, self.training)`
+        # traced under .eval()).  torch's own default is training=True —
+        # F.dropout with the flag absent drops even in module .eval() — so
+        # an absent, truthy, or dynamic flag must raise, not silently
+        # become identity.
+        if tname == "dropout":
+            if len(n.args) > 2:
+                train_flag = n.args[2]
+            else:
+                train_flag = n.kwargs.get("training", True)
+            if train_flag is not False:
+                raise NotImplementedError(
+                    "F.dropout without a trace-time-constant training=False "
+                    "has no converted equivalent (torch's default is "
+                    "training=True even under .eval()); use nn.Dropout "
+                    "modules instead")
         alias[n.name] = res(n.args[0])
         # identity preserves any pending flatten-reorder
         src = res(n.args[0])

@@ -24,11 +24,13 @@ from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
                            UpSampling1D, UpSampling2D, UpSampling3D,
                            ZeroPadding1D, ZeroPadding3D)
 from .layers_zoo import (ActivityRegularization, AddConstant, AlphaDropout,
-                         Conv1DTranspose, Conv3DTranspose, ConvLSTM2D, Cos,
-                         Exp, HardShrink, Identity, LocallyConnected2D, Log,
-                         LRN2D, MulConstant, Negative, Power, Scale,
-                         SeparableConv1D, Softmax, SoftShrink, Sqrt, Square,
-                         Threshold, WordEmbedding, Merge, merge)
+                         CAdd, CMul, Conv1DTranspose, Conv3DTranspose,
+                         ConvLSTM2D, ConvLSTM3D, Cos, Exp, GaussianSampler,
+                         HardShrink, HardTanh, Identity, LocallyConnected2D,
+                         Log, LRN2D, MulConstant, Negative, Power,
+                         ResizeBilinear, Scale, SeparableConv1D, Softmax,
+                         SoftShrink, Sqrt, Square, Threshold, WordEmbedding,
+                         Merge, merge)
 from .functional import Input, Model, SymbolicTensor
 from .module import Module, Scope, param_count
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
@@ -42,6 +44,14 @@ Deconvolution2D = Conv2DTranspose
 Deconvolution3D = Conv3DTranspose
 AtrousConvolution1D = Conv1D   # dilation= covers the atrous variants
 AtrousConvolution2D = Conv2D
+# BigDL ShareConvolution was a memory-sharing twin of SpatialConvolution;
+# functionally identical, and XLA owns buffer reuse here
+ShareConvolution2D = Conv2D
+SeparableConvolution2D = SeparableConv2D
+# zoo's Sparse* layers existed for sparse-gradient CPU training; XLA's
+# scatter/gather handles the same access pattern on dense TPU arrays
+SparseEmbedding = Embedding
+SparseDense = Dense
 
 __all__ = [
     "activations", "initializers", "losses", "metrics",
@@ -74,7 +84,11 @@ __all__ = [
     "LRN2D", "Cos", "Identity", "Exp", "Log", "Sqrt", "Square", "Power",
     "Negative", "AddConstant", "MulConstant", "Scale", "Threshold",
     "HardShrink", "SoftShrink", "WordEmbedding", "Merge", "merge",
+    "ConvLSTM3D", "CAdd", "CMul", "HardTanh", "GaussianSampler",
+    "ResizeBilinear",
     # keras-1 naming aliases
     "Convolution1D", "Convolution2D", "Convolution3D", "Deconvolution2D",
     "Deconvolution3D", "AtrousConvolution1D", "AtrousConvolution2D",
+    "ShareConvolution2D", "SeparableConvolution2D", "SparseEmbedding",
+    "SparseDense",
 ]

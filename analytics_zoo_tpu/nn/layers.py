@@ -61,10 +61,25 @@ class Dense(Module):
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         w = scope.param("kernel", self.kernel_init, (x.shape[-1], self.units))
-        y = jnp.dot(_cast_for_compute(x, self.dtype),
-                    _cast_for_compute(w, self.dtype),
-                    preferred_element_type=jnp.float32)
-        y = y.astype(x.dtype) if x.dtype != y.dtype else y
+        q = scope.quant
+        if q is not None and q.mode == "collect":
+            q.observe(scope.path, x)
+        y = None
+        if isinstance(w, dict):  # int8 serving: {marker, q, scale} kernel
+            from . import quant as _quant
+            if q is not None and q.mode == "apply":
+                y = _quant.dense_quantized(q, scope.path, x, w["q"],
+                                           w["scale"], q.compute_dtype)
+                if y is not None:
+                    y = y.astype(x.dtype)
+            if y is None:  # weight-only: dequant fuses into the matmul
+                w = (w["q"].astype(x.dtype)
+                     * w["scale"].astype(x.dtype))
+        if y is None:
+            y = jnp.dot(_cast_for_compute(x, self.dtype),
+                        _cast_for_compute(w, self.dtype),
+                        preferred_element_type=jnp.float32)
+            y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", self.bias_init, (self.units,))
             y = y + b.astype(y.dtype)  # don't promote bf16 back to f32

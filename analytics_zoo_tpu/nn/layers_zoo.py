@@ -27,68 +27,112 @@ from .module import Module, Scope
 
 # -- recurrent convolution -----------------------------------------------------
 
-class ConvLSTM2D(Module):
-    """Convolutional LSTM (reference: ConvLSTM2D — zoo keras layers; BigdDL
-    ConvLSTM2D/3D).  Input [B, T, H, W, C], NHWC frames; gates are convs of
-    the frame and the hidden state, recurrence via lax.scan (compiler-
-    friendly: one compiled step body, no Python loop)."""
+def _hard_sigmoid_k1(x: jax.Array) -> jax.Array:
+    """keras-1/BigDL hard_sigmoid: clip(0.2*x + 0.5, 0, 1).  jax.nn's (and
+    keras 3's) hard_sigmoid is relu6(x+3)/6 — a different slope."""
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class _ConvLSTMND(Module):
+    """Shared convolutional-LSTM recurrence over [B, T, *spatial, C]
+    frames (reference: zoo keras ConvLSTM2D; BigDL ConvLSTM2D/3D): gates
+    are convs of the frame and the hidden state, recurrence via lax.scan
+    (compiler-friendly: one compiled step body, no Python loop).
+    Rank-specific subclasses supply the conv dimension numbers.
+
+    keras-1 defaults: tanh cell activation, hard_sigmoid gates (the
+    LEGACY piecewise-linear clip(0.2x + 0.5) — keras 3 redefined
+    "hard_sigmoid" as relu6(x+3)/6, which is NOT the reference's), and
+    unit forget-gate bias."""
+
+    _rank: int
+    _dims: Tuple[str, str, str]
 
     def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
                  strides: Union[int, Sequence[int]] = 1,
                  padding: Any = "same",
+                 activation: Any = "tanh",
+                 recurrent_activation: Any = "hard_sigmoid",
+                 unit_forget_bias: bool = True,
                  return_sequences: bool = False, go_backwards: bool = False,
                  kernel_init: Any = "glorot_uniform",
                  name: Optional[str] = None):
         super().__init__(name)
+        norm = _pair if self._rank == 2 else _triple
         self.filters = filters
-        self.kernel_size = _pair(kernel_size)
-        self.strides = _pair(strides)
+        self.kernel_size = norm(kernel_size)
+        self.strides = norm(strides)
         self.padding = _norm_padding(padding)
+        self.activation = (_hard_sigmoid_k1 if activation == "hard_sigmoid"
+                           else activations.get(activation))
+        self.recurrent_activation = (
+            _hard_sigmoid_k1 if recurrent_activation == "hard_sigmoid"
+            else activations.get(recurrent_activation))
+        self.unit_forget_bias = unit_forget_bias
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
         self.kernel_init = initializers.get(kernel_init)
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
-        if x.ndim != 5:
-            raise ValueError(f"ConvLSTM2D wants [B,T,H,W,C], got {x.shape}")
-        b, t, h, w, c = x.shape
-        kh, kw = self.kernel_size
+        r = self._rank
+        if x.ndim != r + 3:
+            spatial_names = "D,H,W" if r == 3 else "H,W"
+            raise ValueError(f"{type(self).__name__} wants "
+                             f"[B,T,{spatial_names},C], got {x.shape}")
+        b, t = x.shape[:2]
+        spatial, c = x.shape[2:-1], x.shape[-1]
         f = self.filters
-        wx = scope.param("kernel", self.kernel_init, (kh, kw, c, 4 * f))
+        wx = scope.param("kernel", self.kernel_init,
+                         self.kernel_size + (c, 4 * f))
         wh = scope.param("recurrent_kernel", self.kernel_init,
-                         (kh, kw, f, 4 * f))
-        bias = scope.param("bias", initializers.get("zeros"), (4 * f,))
+                         self.kernel_size + (f, 4 * f))
+
+        def bias_init(key, shape, dtype=jnp.float32):
+            bval = jnp.zeros(shape, dtype)
+            if self.unit_forget_bias:  # gate order i,f,g,o
+                bval = bval.at[f:2 * f].set(1.0)
+            return bval
+
+        bias = scope.param("bias", bias_init, (4 * f,))
 
         def conv(inp, kern, strides, padding):
             return jax.lax.conv_general_dilated(
                 inp, kern, window_strides=strides, padding=padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                dimension_numbers=self._dims)
 
-        # spatial dims after the (possibly strided/valid) input conv; the
+        # spatial grid after the (possibly strided/valid) input conv; the
         # recurrent conv is ALWAYS stride-1 SAME over that grid (keras
         # semantics — it must preserve the hidden-state shape)
-        oh = jax.eval_shape(
+        grid = jax.eval_shape(
             lambda a: conv(a, wx, self.strides, self.padding),
-            jax.ShapeDtypeStruct((b, h, w, c), x.dtype)).shape[1:3]
+            jax.ShapeDtypeStruct((b,) + spatial + (c,), x.dtype)).shape[1:-1]
+        ones = (1,) * r
 
         def step(carry, xt):
             hid, cell = carry
             z = (conv(xt, wx, self.strides, self.padding)
-                 + conv(hid, wh, (1, 1), "SAME") + bias)
+                 + conv(hid, wh, ones, "SAME") + bias)
             i, fg, g, o = jnp.split(z, 4, axis=-1)
-            cell = jax.nn.sigmoid(fg) * cell + jax.nn.sigmoid(i) * jnp.tanh(g)
-            hid = jax.nn.sigmoid(o) * jnp.tanh(cell)
+            act, rec = self.activation, self.recurrent_activation
+            cell = rec(fg) * cell + rec(i) * act(g)
+            hid = rec(o) * act(cell)
             return (hid, cell), hid
 
-        seq = jnp.moveaxis(x, 1, 0)  # [T, B, H, W, C]
-        init = (jnp.zeros((b,) + oh + (f,), x.dtype),
-                jnp.zeros((b,) + oh + (f,), x.dtype))
+        seq = jnp.moveaxis(x, 1, 0)  # [T, B, *spatial, C]
+        init = (jnp.zeros((b,) + grid + (f,), x.dtype),
+                jnp.zeros((b,) + grid + (f,), x.dtype))
         (hid, _), outs = jax.lax.scan(step, init, seq,
                                       reverse=self.go_backwards)
         if self.return_sequences:
-            outs = jnp.moveaxis(outs, 0, 1)  # [B, T, OH, OW, F]
+            outs = jnp.moveaxis(outs, 0, 1)  # [B, T, *grid, F]
             return outs[:, ::-1] if self.go_backwards else outs
         return hid
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """Convolutional LSTM over [B, T, H, W, C] NHWC frames."""
+    _rank = 2
+    _dims = ("NHWC", "HWIO", "NHWC")
 
 
 # -- unshared convolution ------------------------------------------------------
@@ -518,6 +562,106 @@ class SoftShrink(Module):
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         return (jnp.sign(x)
                 * jnp.maximum(jnp.abs(x) - self.lam, 0.0)).astype(x.dtype)
+
+
+class CAdd(Module):
+    """Trainable bias of an explicit shape, broadcast-added (BigDL CAdd;
+    zoo keras-1 exposed it directly)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        b = scope.param("bias", initializers.get("zeros"), self.size)
+        return x + b.astype(x.dtype)
+
+
+class CMul(Module):
+    """Trainable scale of an explicit shape, broadcast-multiplied (BigDL
+    CMul)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        w = scope.param("weight", initializers.get("ones"), self.size)
+        return x * w.astype(x.dtype)
+
+
+class HardTanh(Module):
+    """clip(x, min_value, max_value) (BigDL HardTanh)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class GaussianSampler(Module):
+    """VAE reparameterization: input [mean, log_var] -> mean + eps*std
+    (BigDL GaussianSampler; zoo keras-1's VAE building block).  Sampling
+    uses the scope rng in training mode; eval returns the mean (the
+    deterministic serving behavior)."""
+
+    def forward(self, scope: Scope, inputs: Sequence[jax.Array]) -> jax.Array:
+        mean, log_var = inputs
+        if not scope.training:
+            return mean
+        eps = jax.random.normal(scope.make_rng(), mean.shape,
+                                dtype=mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize of NHWC maps to (output_height, output_width)
+    (BigDL/zoo ResizeBilinear).  Sampling matches the reference's legacy-
+    TF1 grid — ``src = dst * scale`` from the corner origin (and the
+    ``align_corners=True`` variant) — NOT the half-pixel-center grid of
+    jax.image.resize / TF2, which yields different pixels."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.out_hw = (output_height, output_width)
+        self.align_corners = align_corners
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        oh, ow = self.out_hw
+
+        def grid(o_size, i_size):
+            if self.align_corners and o_size > 1:
+                scale = (i_size - 1) / (o_size - 1)
+            else:
+                scale = i_size / o_size
+            src = jnp.arange(o_size, dtype=jnp.float32) * scale
+            lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, i_size - 1)
+            hi = jnp.minimum(lo + 1, i_size - 1)
+            return lo, hi, (src - lo).astype(jnp.float32)
+
+        y0, y1, wy = grid(oh, h)
+        x0, x1, wx = grid(ow, w)
+        xf = x.astype(jnp.float32)
+
+        def cols(rows):  # rows: [b, oh, w, c] -> [b, oh, ow, c]
+            return (rows[:, :, x0] * (1.0 - wx)[None, None, :, None]
+                    + rows[:, :, x1] * wx[None, None, :, None])
+
+        out = (cols(xf[:, y0]) * (1.0 - wy)[None, :, None, None]
+               + cols(xf[:, y1]) * wy[None, :, None, None])
+        return out.astype(x.dtype)
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """Volumetric convolutional LSTM over [B, T, D, H, W, C] (BigDL
+    ConvLSTM3D)."""
+    _rank = 3
+    _dims = ("NDHWC", "DHWIO", "NDHWC")
 
 
 # -- keras-1 merge API ---------------------------------------------------------

@@ -33,7 +33,8 @@ class Scope:
 
     def __init__(self, params: Params, state: Params, rng: Optional[jax.Array],
                  training: bool, init_mode: bool, path: Tuple[str, ...] = (),
-                 taps: Optional[Dict[str, Any]] = None):
+                 taps: Optional[Dict[str, Any]] = None,
+                 quant: Optional[Any] = None):
         self.params = params
         self.state = state
         self.rng = rng
@@ -41,6 +42,7 @@ class Scope:
         self.init_mode = init_mode
         self.path = path
         self.taps = taps  # shared dict: child outputs recorded by path
+        self.quant = quant  # int8 serving context (nn.quant), or None
         self._rng_count = 0
         self._child_counts: Dict[str, int] = {}
         # name → module object.  The object itself (not id()) is kept so the
@@ -106,7 +108,7 @@ class Scope:
                     jax.random.fold_in(self.rng, zlib.crc32(name.encode()))
                     if self.rng is not None else None,
                     self.training, self.init_mode, self.path + (name,),
-                    taps=self.taps)
+                    taps=self.taps, quant=self.quant)
         # weight sharing: re-executing the SAME layer object under the same
         # name (a shared layer in a functional graph) reuses its params; a
         # DIFFERENT module under an already-used name is a naming bug and
@@ -153,12 +155,13 @@ class Module:
         return {"params": scope.params, "state": scope.state}
 
     def apply(self, variables: Params, *args: Any, training: bool = False,
-              rng: Optional[jax.Array] = None, **kwargs: Any
-              ) -> Tuple[Any, Params]:
-        """Pure application: returns (output, new_state)."""
+              rng: Optional[jax.Array] = None, quant: Optional[Any] = None,
+              **kwargs: Any) -> Tuple[Any, Params]:
+        """Pure application: returns (output, new_state).  ``quant``: an
+        nn.quant context for int8 serving (calibration or apply mode)."""
         state_in = variables.get("state", {})
         scope = Scope(variables.get("params", {}), dict(state_in), rng,
-                      training, init_mode=False)
+                      training, init_mode=False, quant=quant)
         out = self.forward(scope, *args, **kwargs)
         return out, scope.state
 

@@ -1,0 +1,70 @@
+"""Static int8 activation quantization for serving.
+
+Reference (SURVEY.md §2.8): the OpenVINO path ran INT8 inference with
+activation scales derived from an offline CALIBRATION pass
+(``OpenVinoInferenceSupportive`` model-optimizer INT8 calibration).  The
+TPU-native analog: a quant context threaded through the module ``Scope``
+— a calibration pass records each Dense input's absolute maximum (static,
+per-tensor), then serving-time Dense layers quantize activations with
+those frozen scales and run the matmul as int8 x int8 -> int32 on the MXU,
+rescaling per output channel.
+
+Only Dense participates in activation quantization (the transformer/
+recommender serving hot path); conv layers keep weight-only int8 (their
+dequant fuses into the conv).  ``InferenceModel.load(dtype="int8",
+calibrate=batch)`` wires it up.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Calibrator:
+    """Collect mode: observe per-layer activation ranges on a (concrete,
+    unjitted) calibration forward; layers still compute in float."""
+
+    def __init__(self):
+        self.amax: Dict[str, float] = {}
+
+    mode = "collect"
+
+    def observe(self, path: Tuple[str, ...], x: jax.Array) -> None:
+        key = "/".join(path)
+        val = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
+        self.amax[key] = max(self.amax.get(key, 0.0), val)
+
+
+class QuantApply:
+    """Apply mode: frozen per-tensor activation scales (baked into the
+    jitted executable as constants) + per-channel int8 weights."""
+
+    mode = "apply"
+
+    def __init__(self, amax: Dict[str, float], compute_dtype=jnp.bfloat16):
+        self.amax = dict(amax)
+        self.compute_dtype = compute_dtype
+
+    def scale_for(self, path: Tuple[str, ...]) -> Optional[float]:
+        a = self.amax.get("/".join(path))
+        if a is None or a <= 0.0:
+            return None
+        return a / 127.0
+
+
+def dense_quantized(ctx, path, x, wq, w_scale, compute_dtype):
+    """int8 GEMM with static activation scale: q(x) @ wq -> int32, then
+    one fused rescale by (s_in * s_w[channel])."""
+    s_in = ctx.scale_for(path)
+    if s_in is None:
+        return None  # layer never seen in calibration: float fallback
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_in)),
+                  -127, 127).astype(jnp.int8)
+    y32 = jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    scale = (jnp.asarray(w_scale, jnp.float32).reshape(-1) * s_in)
+    return (y32.astype(jnp.float32) * scale).astype(compute_dtype)

@@ -67,18 +67,32 @@ def _quantize_tree(variables: Any, compute_dtype: Any) -> Any:
     return walk(variables)
 
 
-def _dequantize_tree(variables: Any, compute_dtype: Any) -> Any:
+def _dequantize_tree(variables: Any, compute_dtype: Any,
+                     dense_paths: Optional[frozenset] = None) -> Any:
     """Inverse of ``_quantize_tree`` — runs INSIDE the jitted forward, so
-    XLA fuses the int8→float multiply into the consumer."""
-    def walk(node):
+    XLA fuses the int8→float multiply into the consumer.  With
+    ``dense_paths`` (calibrated-activation mode: the scope paths the
+    Calibrator saw, i.e. exactly the nn.Dense layers), those layers'
+    kernels stay int8 dicts for Dense's own int8 GEMM path; every other
+    quantized leaf — conv kernels, but also 2-D kernels of layers that
+    CANNOT consume the dict form (LSTM/GRU input kernels, Highway) —
+    dequantizes as usual."""
+    def walk(node, path=()):
         if isinstance(node, dict):
             if _Q_MARKER in node:
+                if (dense_paths is not None and path and path[-1] == "kernel"
+                        and "/".join(path[:-1]) in dense_paths):
+                    return node
                 return (node["q"].astype(compute_dtype)
                         * node["scale"].astype(compute_dtype))
-            return {k: walk(v) for k, v in node.items()}
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
         return node
 
-    return walk(variables)
+    # variables is {"params": ..., "state": ...}; scope paths are relative
+    # to the params root
+    return {k: walk(v) if k != "params" else
+            {kk: walk(vv, (kk,)) for kk, vv in v.items()}
+            for k, v in variables.items()}
 
 
 class InferenceModel:
@@ -96,7 +110,7 @@ class InferenceModel:
     # -- loaders (reference: doLoadBigDL/doLoadTF/doLoadOpenVINO...) ----------
 
     def load(self, model: Module, variables: Dict[str, Any],
-             dtype: Any = None) -> "InferenceModel":
+             dtype: Any = None, calibrate: Any = None) -> "InferenceModel":
         """Load from an nn.Module + its variables.
 
         ``dtype``: optional serving precision —
@@ -104,14 +118,28 @@ class InferenceModel:
           HBM traffic per request, the MXU-native dtype);
         - ``"int8"``: weight-only int8 with per-channel scales (4x less
           parameter traffic; on-chip dequant to bf16 fuses into the
-          consuming matmul).  The reference's OpenVINO INT8 calibration
-          analog (InferenceModel.doLoadOpenVINOInt8)."""
+          consuming matmul).
+        ``calibrate``: with ``dtype="int8"``, a representative input batch
+        — one float forward records every Dense input's absolute maximum;
+        serving then quantizes those ACTIVATIONS with the frozen static
+        scales and runs Dense matmuls as int8 x int8 -> int32 on the MXU
+        (conv layers stay weight-only).  The reference's OpenVINO INT8
+        calibration analog (``OpenVinoInferenceSupportive`` calibrate +
+        doLoadOpenVINOInt8); without ``calibrate`` the int8 path is
+        weight-only, as before."""
         import jax.numpy as jnp
         self._quantized = False
+        self._quant_ctx = None
         # executables are AOT-lowered against the previous load's variable
         # pytree/model — always invalid after a reload
         self._compiled.clear()
         if dtype is not None and _is_int8_request(dtype):
+            if calibrate is not None:
+                from analytics_zoo_tpu.nn.quant import Calibrator, QuantApply
+                collector = Calibrator()
+                model.apply(variables, np.asarray(calibrate),
+                            training=False, quant=collector)
+                self._quant_ctx = QuantApply(collector.amax, jnp.bfloat16)
             variables = _quantize_tree(variables, jnp.bfloat16)
             self._quantized = True
             self._compute_dtype = jnp.bfloat16
@@ -156,11 +184,17 @@ class InferenceModel:
                     model = self._model
                     quantized = self._quantized
                     cdtype = getattr(self, "_compute_dtype", None)
+                    qctx = getattr(self, "_quant_ctx", None)
+
+                    dense_paths = (frozenset(qctx.amax)
+                                   if qctx is not None else None)
 
                     def fwd(variables, x):
                         if quantized:
-                            variables = _dequantize_tree(variables, cdtype)
-                        out, _ = model.apply(variables, x, training=False)
+                            variables = _dequantize_tree(
+                                variables, cdtype, dense_paths=dense_paths)
+                        out, _ = model.apply(variables, x, training=False,
+                                             quant=qctx)
                         return out
 
                     # AOT compile for this exact shape (reference: OpenVINO

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Sharded test runner (reference pattern: pyzoo/dev/run-pytests*.sh —
+# separate pytest processes per shard).  See tests/run.py.
+set -u
+cd "$(dirname "$0")/.."
+exec python -m tests.run "$@"

@@ -1,0 +1,134 @@
+"""Sharded test runner: each test module in its own pytest process.
+
+Reference pattern (SURVEY.md §4.2): the reference never ran its suite in
+one process either — ``pyzoo/dev/run-pytests*.sh`` sharded pytest into
+separate invocations because in-process state conflicts across frameworks.
+The analog here: 370+ tests in a single interpreter accumulate jit
+executables / native-queue / TB-writer state and can abort the interpreter
+deep into the run (round-3 finding), while every module is green standalone.
+One process per module bounds that state by construction.
+
+Usage:
+    python -m tests.run                # full suite, sequential
+    python -m tests.run test_nn data   # only modules matching a substring
+    python -m tests.run --failfast     # stop at first failing module
+
+Exit code 0 iff every module's pytest run passes.  ``dev/run-pytests.sh``
+is the shell-facing wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Per-module wall-clock leash (seconds).  The heavyweights get more; a hang
+# (compile-service stall, deadlocked queue) is reported as a failure with
+# the faulthandler dump instead of wedging the whole run.
+DEFAULT_TIMEOUT = 600
+TIMEOUTS = {
+    "test_models": 1200, "test_examples": 1200, "test_parallel": 1200,
+    "test_net": 900, "test_chronos": 900, "test_automl": 900,
+    "test_docs": 900, "test_multihost": 900,
+}
+
+_TAIL = re.compile(r"(\d+) (passed|failed|error|errors|skipped|xfailed|"
+                   r"xpassed|warnings?|deselected)")
+
+
+def _modules(patterns):
+    mods = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    if patterns:
+        mods = [m for m in mods
+                if any(p in os.path.basename(m) for p in patterns)]
+    return mods
+
+
+def _run_module(path: str) -> dict:
+    name = os.path.splitext(os.path.basename(path))[0]
+    timeout = TIMEOUTS.get(name, DEFAULT_TIMEOUT)
+    cmd = [sys.executable, "-m", "pytest", path, "-q", "--no-header",
+           # dump all thread stacks if a test wedges (leaves 60s for
+           # pytest teardown before our subprocess leash fires)
+           "-o", f"faulthandler_timeout={timeout - 60}"]
+    t0 = time.perf_counter()
+    # Popen + communicate (not subprocess.run): on timeout, run() discards
+    # the pipe contents, losing the faulthandler dump this runner exists
+    # to surface — communicate()'s second attempt reads what's buffered.
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        rc = -1
+        out = (out or "") + f"\n<<runner: module timed out after {timeout}s>>"
+    dt = time.perf_counter() - t0
+    counts = {kind: int(num) for num, kind in _TAIL.findall(
+        "\n".join(out.splitlines()[-5:]))}
+    # pytest rc 5 = "no tests collected": tolerate (e.g. all skipped by
+    # importorskip at collection), but surface it in the summary
+    ok = rc == 0 or rc == 5
+    return {"name": name, "rc": rc, "ok": ok, "seconds": dt,
+            "passed": counts.get("passed", 0),
+            "failed": counts.get("failed", 0) + counts.get("error", 0),
+            "skipped": counts.get("skipped", 0), "output": out}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("patterns", nargs="*",
+                        help="substring filters on module names")
+    parser.add_argument("--failfast", "-x", action="store_true")
+    args = parser.parse_args(argv)
+
+    mods = _modules(args.patterns)
+    if not mods:
+        print(f"no test modules match {args.patterns}", file=sys.stderr)
+        return 2
+    results = []
+    t0 = time.perf_counter()
+    for i, path in enumerate(mods, 1):
+        name = os.path.splitext(os.path.basename(path))[0]
+        print(f"[{i:2d}/{len(mods)}] {name} ...", end="", flush=True)
+        r = _run_module(path)
+        results.append(r)
+        status = "ok" if r["ok"] else f"FAIL(rc={r['rc']})"
+        print(f" {status}  {r['passed']} passed"
+              + (f", {r['failed']} failed" if r["failed"] else "")
+              + (f", {r['skipped']} skipped" if r["skipped"] else "")
+              + f"  [{r['seconds']:.1f}s]", flush=True)
+        if not r["ok"]:
+            tail = "\n".join(r["output"].splitlines()[-40:])
+            print(f"----- {name} output tail -----\n{tail}\n"
+                  f"----- end {name} -----", flush=True)
+            if args.failfast:
+                break
+    total = time.perf_counter() - t0
+    n_pass = sum(r["passed"] for r in results)
+    n_fail = sum(r["failed"] for r in results)
+    n_skip = sum(r["skipped"] for r in results)
+    bad = [r["name"] for r in results if not r["ok"]]
+    slowest = sorted(results, key=lambda r: -r["seconds"])[:5]
+    print(f"\n{len(results)} modules in {total:.0f}s: "
+          f"{n_pass} passed, {n_fail} failed, {n_skip} skipped")
+    print("slowest: " + ", ".join(f"{r['name']} {r['seconds']:.0f}s"
+                                  for r in slowest))
+    if bad:
+        print("FAILED modules: " + ", ".join(bad))
+        return 1
+    print("ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

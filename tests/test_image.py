@@ -196,3 +196,19 @@ def test_color_jitter_transforms():
                            saturation=(1.9, 2.0))(
         img, rng=np.random.default_rng(4))
     assert not np.array_equal(jit, img)
+
+
+def test_resnet_space_to_depth_stem_matches_conv():
+    """stem='space_to_depth' is numerically identical to the plain
+    7x7/s2 SAME conv stem, with an interchangeable param tree."""
+    import jax
+    from analytics_zoo_tpu.models import ResNet
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    conv_net = ResNet(depth=18, class_num=5, width=8)
+    s2d_net = ResNet(depth=18, class_num=5, width=8, stem="space_to_depth")
+    variables = conv_net.init(jax.random.PRNGKey(0), x)
+    want, _ = conv_net.apply(variables, x, training=False)
+    got, _ = s2d_net.apply(variables, x, training=False)  # same params
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)

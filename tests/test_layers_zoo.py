@@ -42,7 +42,9 @@ def test_convlstm2d_matches_tf():
     tf = pytest.importorskip("tensorflow")
     x = np.random.default_rng(0).normal(size=(2, 3, 8, 8, 4)).astype(
         np.float32)
-    ours = nn.ConvLSTM2D(5, 3, return_sequences=True)
+    ours = nn.ConvLSTM2D(5, 3, return_sequences=True,
+                         recurrent_activation="sigmoid",
+                         unit_forget_bias=False)
     variables, out = run(ours, x)
     p = variables["params"]
     ktf = tf.keras.layers.ConvLSTM2D(
@@ -56,6 +58,34 @@ def test_convlstm2d_matches_tf():
     want = ktf(x).numpy()
     np.testing.assert_allclose(out, want, atol=2e-5)
     grad_ok(nn.ConvLSTM2D(5, 3), x)
+
+
+def test_convlstm2d_keras1_defaults():
+    """Defaults are the keras-1/BigDL reference semantics: legacy
+    hard_sigmoid gates (clip(0.2x+0.5)) and unit forget-gate bias."""
+    from analytics_zoo_tpu.nn.layers_zoo import _hard_sigmoid_k1
+    z = np.linspace(-4, 4, 9).astype(np.float32)
+    np.testing.assert_allclose(_hard_sigmoid_k1(jnp.asarray(z)),
+                               np.clip(0.2 * z + 0.5, 0, 1), atol=1e-7)
+    x = np.random.default_rng(2).normal(size=(1, 2, 5, 5, 3)).astype(
+        np.float32)
+    layer = nn.ConvLSTM2D(4, 3)
+    variables, out = run(layer, x)
+    bias = np.asarray(variables["params"]["bias"])
+    np.testing.assert_allclose(bias[4:8], 1.0)   # forget-gate slice
+    np.testing.assert_allclose(bias[:4], 0.0)
+    assert np.all(np.isfinite(out))
+    # single-timestep closed form: h1 = rec(o) * tanh(rec(f)*0 + rec(i)*tanh(g))
+    p = variables["params"]
+    import jax.lax as lax
+    z1 = (np.asarray(lax.conv_general_dilated(
+        x[:, 0], np.asarray(p["kernel"]), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))) + bias)
+    i, f, g, o = np.split(z1, 4, axis=-1)
+    hs = lambda v: np.clip(0.2 * v + 0.5, 0, 1)
+    h1 = hs(o) * np.tanh(hs(i) * np.tanh(g))
+    got, _ = nn.ConvLSTM2D(4, 3).apply(variables, x[:, :1])
+    np.testing.assert_allclose(got, h1, atol=2e-5)
 
 
 def test_convlstm2d_last_state_and_backwards():
@@ -354,3 +384,73 @@ def test_merge_layer_all_modes():
     vv = m.init(RNG, jnp.asarray(a), jnp.asarray(b))
     out, _ = m.apply(vv, jnp.asarray(a), jnp.asarray(b))
     np.testing.assert_allclose(np.asarray(out), a + b, atol=1e-6)
+
+
+# -- keras-1 tail audit backfill (VERDICT r3 missing #5) ----------------------
+
+def test_cadd_cmul_hardtanh():
+    x = np.random.default_rng(0).normal(size=(2, 4, 3)).astype(np.float32)
+    v, out = run(nn.CAdd((3,)), x)
+    np.testing.assert_allclose(out, x)  # zero-init bias
+    v["params"]["bias"] = jnp.ones(3)
+    got, _ = nn.CAdd((3,)).apply(v, x)
+    np.testing.assert_allclose(got, x + 1.0)
+    v, out = run(nn.CMul((3,)), x)
+    np.testing.assert_allclose(out, x)  # ones-init weight
+    _, out = run(nn.HardTanh(-0.5, 0.5), x)
+    np.testing.assert_allclose(out, np.clip(x, -0.5, 0.5))
+    grad_ok(nn.CMul((3,)), x)
+
+
+def test_gaussian_sampler():
+    import jax
+    rng = np.random.default_rng(1)
+    mean = rng.normal(size=(4, 8)).astype(np.float32)
+    log_var = np.full((4, 8), -2.0, np.float32)
+    layer = nn.GaussianSampler()
+    variables = layer.init(jax.random.PRNGKey(0), [mean, log_var])
+    # eval: deterministic mean
+    out, _ = layer.apply(variables, [mean, log_var], training=False)
+    np.testing.assert_allclose(out, mean)
+    # training: mean + eps*std, correct spread
+    outs = [layer.apply(variables, [mean, log_var], training=True,
+                        rng=jax.random.PRNGKey(i))[0] for i in range(30)]
+    stack = np.stack(outs)
+    assert abs(float(stack.mean() - mean.mean())) < 0.05
+    assert abs(float(stack.std(axis=0).mean()) - np.exp(-1.0)) < 0.05
+
+
+def test_resize_bilinear():
+    """Golden: the reference's legacy-TF1 sampling grid
+    (tf.compat.v1.image.resize_bilinear), NOT the TF2 half-pixel grid —
+    the two differ on any non-trivial resize."""
+    x = np.random.default_rng(8).normal(size=(2, 4, 6, 3)).astype(
+        np.float32)
+    _, out = run(nn.ResizeBilinear(7, 9), x)
+    assert out.shape == (2, 7, 9, 3)
+    tf = pytest.importorskip("tensorflow")
+    want = tf.compat.v1.image.resize_bilinear(x, (7, 9)).numpy()
+    np.testing.assert_allclose(out, want, atol=1e-5)
+    _, out_ac = run(nn.ResizeBilinear(7, 9, align_corners=True), x)
+    want_ac = tf.compat.v1.image.resize_bilinear(
+        x, (7, 9), align_corners=True).numpy()
+    np.testing.assert_allclose(out_ac, want_ac, atol=1e-5)
+    # and it is NOT the half-pixel TF2 grid
+    tf2 = tf.image.resize(x, (7, 9), method="bilinear").numpy()
+    assert not np.allclose(out, tf2, atol=1e-3)
+
+
+def test_convlstm3d_shapes_and_grad():
+    x = np.random.default_rng(2).normal(size=(2, 3, 4, 5, 5, 2)).astype(
+        np.float32)
+    _, seq = run(nn.ConvLSTM3D(3, 3, return_sequences=True), x)
+    assert seq.shape == (2, 3, 4, 5, 5, 3)
+    _, last = run(nn.ConvLSTM3D(3, 3), x)
+    np.testing.assert_allclose(last, seq[:, -1], atol=1e-6)
+    grad_ok(nn.ConvLSTM3D(2, 3), x[:1, :2, :3, :4, :4])
+
+
+def test_keras1_alias_layers():
+    assert nn.ShareConvolution2D is nn.Conv2D
+    assert nn.SparseEmbedding is nn.Embedding
+    assert nn.SparseDense is nn.Dense

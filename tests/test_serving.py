@@ -339,6 +339,67 @@ def test_inference_model_int8_weight_quantization():
     assert not isinstance(layer0["bias"], dict)
 
 
+def test_inference_model_int8_calibrated_activations():
+    """Calibrated int8 (reference: OpenVINO INT8 calibration): a
+    calibration batch freezes static per-tensor activation scales; Dense
+    matmuls then run int8 x int8 -> int32 with per-channel rescale.
+    Accuracy must stay close to f32, and the activation scales must
+    actually come from the calibration pass."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(256, activation="relu"),
+                           nn.Dense(128, activation="relu"),
+                           nn.Dense(10)])
+    rng = np.random.default_rng(3)
+    calib = rng.normal(size=(32, 64)).astype(np.float32)
+    x = rng.normal(size=(16, 64)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(calib))
+
+    ref = InferenceModel().load(model, variables)
+    q = InferenceModel().load(model, variables, dtype="int8",
+                              calibrate=calib)
+    # one scale per Dense layer, recorded during the calibration forward
+    assert q._quant_ctx is not None and len(q._quant_ctx.amax) == 3
+    assert all(a > 0 for a in q._quant_ctx.amax.values())
+    out_ref = np.asarray(ref.predict(x), np.float32)
+    out_q = np.asarray(q.predict(x), np.float32)
+    # int8 weights AND int8 activations: bounded accuracy delta vs f32
+    denom = np.maximum(np.abs(out_ref), 1.0)
+    assert np.max(np.abs(out_q - out_ref) / denom) < 0.15
+    # ranking (the serving-relevant signal) preserved on most rows
+    agree = np.mean(out_q.argmax(1) == out_ref.argmax(1))
+    assert agree >= 0.8
+
+
+def test_inference_model_int8_calibrated_with_lstm():
+    """Regression (r4 review): calibrated int8 must leave NON-Dense 2-D
+    kernels (LSTM input/recurrent kernels) dequantized — only nn.Dense
+    can consume the int8 dict form."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.LSTM(64), nn.Dense(16, activation="relu"),
+                           nn.Dense(4)])
+    rng = np.random.default_rng(5)
+    calib = rng.normal(size=(8, 12, 16)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(calib))
+    ref = InferenceModel().load(model, variables)
+    q = InferenceModel().load(model, variables, dtype="int8",
+                              calibrate=calib)
+    x = rng.normal(size=(4, 12, 16)).astype(np.float32)
+    out_ref = np.asarray(ref.predict(x), np.float32)
+    out_q = np.asarray(q.predict(x), np.float32)  # must not crash
+    denom = np.maximum(np.abs(out_ref), 1.0)
+    assert np.max(np.abs(out_q - out_ref) / denom) < 0.2
+
+
 def test_inference_model_reload_and_int8_dtype_spellings():
     """Regression (r3 review): reloading clears stale executables, and
     jnp.int8/np.int8 route to weight-only quantization (NOT a float->int
