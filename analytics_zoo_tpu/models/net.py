@@ -504,6 +504,11 @@ def _load_torch_fx(module: Any, example_input: Any) -> ForeignGraphNet:
     alias: Dict[str, str] = {}
     # env name -> NCHW shape its value was flattened from (kernel reorder)
     flat_origin: Dict[str, Tuple[int, ...]] = {}
+    # env name -> True if the value derives ONLY from constant tensors
+    # (get_attr and ops thereof) and is non-scalar: such values kept
+    # torch's natural (NCHW-flat) element order, so combining them
+    # elementwise with a flattened NHWC feature map would misorder
+    const_origin: Dict[str, bool] = {}
 
     def res(n) -> str:
         name = n.name
@@ -568,9 +573,18 @@ def _load_torch_fx(module: Any, example_input: Any) -> ForeignGraphNet:
                 state[n.name] = s
             continue
         if n.op in ("call_function", "call_method"):
-            handled = _fx_function(n, shp, res, refargs, alias, flat_origin)
+            handled = _fx_function(n, shp, res, refargs, alias, flat_origin,
+                                   const_origin)
             if handled is not None:
                 nodes.append(handled)
+            # constant-ness flows through any op whose every node operand
+            # is constant-derived (conservative: scalar-producing ops on
+            # non-scalar constants stay flagged — a false raise is safe,
+            # a silent misorder is not)
+            operands = [a for a in n.args if isinstance(a, fx.Node)]
+            if operands and all(res(a) in const_origin for a in operands):
+                const_origin[res(n)] = any(const_origin[res(a)]
+                                           for a in operands)
             continue
         if n.op == "get_attr":
             # a constant tensor/parameter referenced directly in forward;
@@ -582,6 +596,7 @@ def _load_torch_fx(module: Any, example_input: Any) -> ForeignGraphNet:
             val = np.asarray(t.detach().cpu().numpy())
             if val.ndim == 4:
                 val = val.transpose(0, 2, 3, 1)
+            const_origin[n.name] = val.size > 1
             nodes.append({"name": n.name, "module": None,
                           "fn": (lambda v=val: jnp.asarray(v)), "args": []})
             continue
@@ -594,7 +609,8 @@ def _load_torch_fx(module: Any, example_input: Any) -> ForeignGraphNet:
                            source="torch", nchw_input=nchw)
 
 
-def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
+def _fx_function(n, shp, res, refargs, alias, flat_origin,
+                 const_origin) -> Optional[Dict]:
     """Convert one fx call_function/call_method node; returns a graph node,
     records an alias (identity ops), or raises for unsupported ops."""
     import operator as op
@@ -633,23 +649,22 @@ def _fx_function(n, shp, res, refargs, alias, flat_origin) -> Optional[Dict]:
     }
     for names, fn in binops.items():
         if tname in names:
-            # a flattened operand is in NHWC-flat element order; a constant
-            # tensor operand (get_attr) kept torch's NCHW-flat order, so a
-            # non-scalar constant combined elementwise would silently
-            # misorder (same hazard _POSITIONAL_PARAM_KINDS guards for
-            # modules)
+            # a flattened operand is in NHWC-flat element order; a
+            # constant-derived operand (get_attr, or any chain of ops on
+            # constants — tracked in const_origin) kept torch's NCHW-flat
+            # order, so a non-scalar constant combined elementwise would
+            # silently misorder (same hazard _POSITIONAL_PARAM_KINDS
+            # guards for modules)
             operands = [a for a in n.args[:2] if isinstance(a, fx.Node)]
             if any(res(a) in flat_origin for a in operands):
                 for a in operands:
-                    if a.op == "get_attr":
-                        s = shp(a)
-                        if s is None or int(np.prod(s)) > 1:
-                            raise NotImplementedError(
-                                f"elementwise {tname} between a flattened "
-                                "NCHW feature map and a non-scalar constant "
-                                "tensor would need the constant reordered "
-                                "to NHWC-flat order, which is unsupported; "
-                                "use the escape hatch")
+                    if const_origin.get(res(a)):
+                        raise NotImplementedError(
+                            f"elementwise {tname} between a flattened "
+                            "NCHW feature map and a non-scalar constant "
+                            "tensor would need the constant reordered "
+                            "to NHWC-flat order, which is unsupported; "
+                            "use the escape hatch")
             propagate_flat()
             return node(fn, n.args[:2])
 

@@ -73,8 +73,9 @@ class MultiHeadAttention(Module):
 
         def proj(name: str, src: jax.Array) -> jax.Array:
             w = scope.param(name, init, (src.shape[-1], h * d_head))
-            y = jnp.dot(src, w.astype(src.dtype),
-                        preferred_element_type=jnp.float32).astype(src.dtype)
+            # same-dtype dot: an f32-preferred output downcast right after
+            # would make both vjp matmuls mixed f32 x bf16 (see Dense)
+            y = jnp.dot(src, w.astype(src.dtype))
             return y.reshape(src.shape[:-1] + (h, d_head))
 
         q = proj("wq", x)
@@ -97,8 +98,7 @@ class MultiHeadAttention(Module):
 
         wo = scope.param("wo", init, (h * d_head, d_model))
         out = jnp.dot(ctx.reshape(x.shape[:-1] + (h * d_head,)),
-                      wo.astype(x.dtype),
-                      preferred_element_type=jnp.float32).astype(x.dtype)
+                      wo.astype(x.dtype))
         return scope.child(Dropout(self.dropout), out, name="drop")
 
 

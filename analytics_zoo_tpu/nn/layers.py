@@ -76,9 +76,13 @@ class Dense(Module):
                 w = (w["q"].astype(x.dtype)
                      * w["scale"].astype(x.dtype))
         if y is None:
-            y = jnp.dot(_cast_for_compute(x, self.dtype),
-                        _cast_for_compute(w, self.dtype),
-                        preferred_element_type=jnp.float32)
+            xc = _cast_for_compute(x, self.dtype)
+            # No preferred_element_type=f32: the MXU accumulates bf16
+            # matmuls in f32 internally, and an f32-typed output whose
+            # only consumer downcasts would poison the WHOLE backward —
+            # the f32 cotangent turns both vjp matmuls into mixed
+            # f32 x bf16 dots (measured: the dominant BERT bwd cost).
+            y = jnp.dot(xc, _cast_for_compute(w, self.dtype).astype(xc.dtype))
             y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", self.bias_init, (self.units,))
@@ -183,15 +187,29 @@ class Conv2D(Module):
         w = scope.param("kernel", self.kernel_init,
                         (kh, kw, in_ch // self.groups, self.filters))
         xc = _cast_for_compute(x, self.dtype)
-        # No preferred_element_type: the conv vjp in this JAX version rejects
-        # mixed (bf16 cotangent, f32-preferred) operands, and the TPU MXU
-        # accumulates bf16 convs in f32 natively anyway.
-        y = jax.lax.conv_general_dilated(
-            xc, _cast_for_compute(w, self.dtype).astype(xc.dtype),
-            window_strides=self.strides, padding=self.padding,
-            rhs_dilation=self.dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.groups)
+        wc = _cast_for_compute(w, self.dtype).astype(xc.dtype)
+        pad_free = (self.padding in ("SAME", "VALID")
+                    or all(p == (0, 0) for p in self.padding))
+        if (kh == kw == 1 and self.strides == (1, 1) and pad_free
+                and self.dilation == (1, 1) and self.groups == 1):
+            # 1x1/s1 conv as an explicit matmul over flattened positions.
+            # Same math, but the vjp becomes two dot_generals — profiled:
+            # XLA lowered these convs' WEIGHT gradients to VPU
+            # multiply-reduce fusions (~0.5 ms each across ResNet's ~30
+            # 1x1 convs) instead of MXU matmuls (~0.03 ms).
+            y = jnp.dot(xc.reshape(-1, in_ch), wc.reshape(in_ch,
+                                                          self.filters))
+            y = y.reshape(x.shape[:-1] + (self.filters,))
+        else:
+            # No preferred_element_type: the conv vjp in this JAX version
+            # rejects mixed (bf16 cotangent, f32-preferred) operands, and
+            # the TPU MXU accumulates bf16 convs in f32 natively anyway.
+            y = jax.lax.conv_general_dilated(
+                xc, wc,
+                window_strides=self.strides, padding=self.padding,
+                rhs_dilation=self.dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.groups)
         y = y.astype(x.dtype) if x.dtype != y.dtype else y
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"), (self.filters,))
@@ -316,10 +334,28 @@ class BatchNormalization(Module):
         var_run = scope.variable("var", lambda: jnp.ones((dim,)))
         if scope.training:
             # statistics in f32 (bf16 accumulation over B*H*W loses too
-            # much), state stays f32
+            # much), state stays f32.  E[xc^2] - E[xc]^2 instead of the
+            # two-pass var: both reductions share one fused read of the
+            # activation (multi-output fusion) — BN is bandwidth-bound, so
+            # a second full pass over every feature map is measurable.
+            # xc is shifted by one stop-gradded SAMPLE per channel:
+            # moments are shift-invariant (so values and gradients are
+            # analytically unchanged), but the shift keeps the
+            # mean-of-squares subtraction from cancelling catastrophically
+            # for badly centered channels (|mean| >> std), where the raw
+            # E[x^2]-E[x]^2 in f32 collapses to garbage.
             xf = x.astype(jnp.float32)
-            mean = xf.mean(axis=reduce_axes)
-            var = xf.var(axis=reduce_axes)
+            idx = tuple(0 if i in reduce_axes else slice(None)
+                        for i in range(x.ndim))
+            shift = jax.lax.stop_gradient(xf[idx]).reshape(
+                [1 if i in reduce_axes else x.shape[i]
+                 for i in range(x.ndim)])
+            xc = xf - shift
+            mean_c = xc.mean(axis=reduce_axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xc), axis=reduce_axes)
+                - jnp.square(mean_c), 0.0)
+            mean = mean_c + shift.reshape(-1)
             m = self.momentum
             scope.put_variable("mean", m * mean_run + (1 - m) * mean)
             scope.put_variable("var", m * var_run + (1 - m) * var)
@@ -327,23 +363,25 @@ class BatchNormalization(Module):
             mean, var = mean_run, var_run
         shape = [1] * x.ndim
         shape[self.axis] = dim
-        # fold (mean, var, gamma, beta) into per-channel scale/shift (tiny
-        # [C] vectors) so the activation tensor sees ONE multiply-add; the
-        # multiply-add itself runs in f32 (x*inv can be huge for badly
-        # centered channels — doing it in bf16 loses the cancellation
-        # against shift) and XLA fuses the upcast/downcast into the same
-        # elementwise kernel
+        # Mean-centered form in the ACTIVATION dtype: (x - mean) is a
+        # cancellation-safe subtraction of nearby values, after which the
+        # scale/shift multiply is well-conditioned in bf16.  (The earlier
+        # x*inv + shift form needed f32 — x*inv and shift can be huge and
+        # cancel — but its f32 output forced every BN backward pass into
+        # f32 elementwise kernels: 2x the HBM bytes of bf16 on a
+        # bandwidth-bound model.)  Statistics stay f32.
         inv = jax.lax.rsqrt(var + self.epsilon)
         if self.scale:
             inv = inv * scope.param("gamma", initializers.get("ones"),
                                     (dim,))
-        shift = -mean * inv
-        if self.center:
-            shift = shift + scope.param("beta", initializers.get("zeros"),
-                                        (dim,))
-        y = (x.astype(jnp.float32) * inv.reshape(shape)
-             + shift.reshape(shape))
-        return y.astype(x.dtype)
+        beta = (scope.param("beta", initializers.get("zeros"), (dim,))
+                if self.center else None)
+        mean_c = mean.astype(x.dtype).reshape(shape)
+        inv_c = inv.astype(x.dtype).reshape(shape)
+        y = (x - mean_c) * inv_c
+        if beta is not None:
+            y = y + beta.astype(x.dtype).reshape(shape)
+        return y
 
 
 class LayerNormalization(Module):

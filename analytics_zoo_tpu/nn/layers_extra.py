@@ -180,8 +180,7 @@ class LocallyConnected1D(Module):
         win = win.reshape(b, out_t, self.kernel_size * c)
         w = scope.param("kernel", self.kernel_init,
                         (out_t, self.kernel_size * c, self.filters))
-        y = jnp.einsum("btk,tkf->btf", win, w.astype(win.dtype),
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+        y = jnp.einsum("btk,tkf->btf", win, w.astype(win.dtype))
         if self.use_bias:
             bias = scope.param("bias", initializers.get("zeros"),
                                (out_t, self.filters))
@@ -708,8 +707,7 @@ class MaxoutDense(Module):
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         w = scope.param("kernel", initializers.get("glorot_uniform"),
                         (self.nb_feature, x.shape[-1], self.units))
-        y = jnp.einsum("bd,kdu->bku", x, w.astype(x.dtype),
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+        y = jnp.einsum("bd,kdu->bku", x, w.astype(x.dtype))
         if self.use_bias:
             b = scope.param("bias", initializers.get("zeros"),
                             (self.nb_feature, self.units))

@@ -8,5 +8,6 @@ flash attention (O(T) memory softmax-attention, MXU-tiled).
 """
 
 from .flash_attention import flash_attention, mha_reference
+from .fused_xent import fused_softmax_xent
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "mha_reference", "fused_softmax_xent"]

@@ -1,0 +1,113 @@
+"""Fused softmax cross-entropy over a vocabulary head projection.
+
+The naive path for a language-model head — ``logits = h @ W`` then
+``sparse_categorical_crossentropy(logits, labels)`` — materializes the
+full [tokens, vocab] logits tensor in f32 HBM several times (fwd logits,
+softmax grad, head-matmul bwd reads): for BERT-base at B=8, seq=512 that
+is ~0.5 GB per pass, profiled at ~10% of the train step.
+
+``fused_softmax_xent`` computes the same loss WITHOUT ever materializing
+the full logits: tokens are processed in chunks (lax.scan); each chunk's
+logits live only inside the scanned body, the forward keeps just the
+per-token logsumexp (one f32 per token), and the backward recomputes the
+chunk's logits to form softmax-minus-onehot directly in bf16 for the two
+MXU gradient matmuls.  One extra head-matmul of recompute (~6% of model
+FLOPs) buys the elimination of every full-size f32 logits round-trip.
+
+Loss definition matches ``losses.sparse_categorical_crossentropy`` on
+logits: mean over all tokens of ``logsumexp(logits) - logits[label]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _flatten(h, labels):
+    d = h.shape[-1]
+    return h.reshape(-1, d), labels.reshape(-1)
+
+
+def fused_softmax_xent(h: jax.Array, w: jax.Array, labels: jax.Array,
+                       chunk: int = 512,
+                       bias: Optional[jax.Array] = None) -> jax.Array:
+    """Mean cross-entropy of ``softmax(h @ w + bias)`` against integer
+    labels.
+
+    h: [..., D] activations (bf16/f32); w: [D, V] head kernel;
+    labels: integer [...] matching h's leading dims; bias: optional [V].
+    ``chunk`` must divide the flattened token count.
+    """
+    if bias is None:
+        bias = jnp.zeros((w.shape[1],), jnp.float32)
+    return _fused(h, w, bias, labels, chunk)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(h, w, bias, labels, chunk):
+    loss, _ = _fused_fwd_impl(h, w, bias, labels, chunk)
+    return loss
+
+
+def _fused_fwd_impl(h, w, bias, labels, chunk):
+    hf, lf = _flatten(h, labels)
+    n = hf.shape[0]
+    if n % chunk:
+        raise ValueError(f"token count {n} not divisible by chunk={chunk}")
+    hc = hf.reshape(n // chunk, chunk, hf.shape[1])
+    lc = lf.reshape(n // chunk, chunk)
+    bf = bias.astype(jnp.float32)
+
+    def body(acc, inp):
+        hcb, lcb = inp
+        logits = jnp.dot(hcb, w.astype(hcb.dtype),
+                         preferred_element_type=jnp.float32) + bf
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        corr = jnp.take_along_axis(logits, lcb[:, None], axis=-1)[:, 0]
+        return acc + (lse - corr).sum(), lse
+
+    total, lses = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hc, lc))
+    return total / n, lses
+
+
+def _fused_fwd(h, w, bias, labels, chunk):
+    loss, lses = _fused_fwd_impl(h, w, bias, labels, chunk)
+    return loss, (h, w, bias, labels, lses)
+
+
+def _fused_bwd(chunk, res, g):
+    h, w, bias, labels, lses = res
+    hf, lf = _flatten(h, labels)
+    n, d = hf.shape
+    v = w.shape[1]
+    hc = hf.reshape(n // chunk, chunk, d)
+    lc = lf.reshape(n // chunk, chunk)
+    scale = (g / n).astype(jnp.float32)
+    wt = w.astype(hf.dtype)
+    bf = bias.astype(jnp.float32)
+
+    def body(carry, inp):
+        dw_acc, db_acc = carry
+        hcb, lcb, lseb = inp
+        logits = jnp.dot(hcb, wt, preferred_element_type=jnp.float32) + bf
+        p = jnp.exp(logits - lseb[:, None])
+        dl = p * scale
+        dl = dl.at[jnp.arange(chunk), lcb].add(-scale)
+        dlb = dl.astype(hcb.dtype)          # bf16 for the MXU matmuls
+        dh_c = jnp.dot(dlb, wt.T)
+        dw_acc = dw_acc + jnp.dot(hcb.T, dlb,
+                                  preferred_element_type=jnp.float32)
+        return (dw_acc, db_acc + dl.sum(axis=0)), dh_c
+
+    (dw, db), dh_chunks = jax.lax.scan(
+        body, (jnp.zeros((d, v), jnp.float32), jnp.zeros((v,), jnp.float32)),
+        (hc, lc, lses))
+    dh = dh_chunks.reshape(h.shape).astype(h.dtype)
+    return dh, dw.astype(w.dtype), db.astype(bias.dtype), None
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)

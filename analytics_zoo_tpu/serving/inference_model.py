@@ -133,6 +133,12 @@ class InferenceModel:
         # executables are AOT-lowered against the previous load's variable
         # pytree/model — always invalid after a reload
         self._compiled.clear()
+        if calibrate is not None and not (dtype is not None
+                                          and _is_int8_request(dtype)):
+            raise ValueError(
+                "calibrate= only applies to dtype='int8' serving; got "
+                f"dtype={dtype!r} — a silently ignored calibration batch "
+                "would leave you believing you deployed calibrated int8")
         if dtype is not None and _is_int8_request(dtype):
             if calibrate is not None:
                 from analytics_zoo_tpu.nn.quant import Calibrator, QuantApply

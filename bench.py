@@ -161,9 +161,14 @@ def bench_bert() -> None:
     from analytics_zoo_tpu.orca.learn import Estimator
 
     d_model, n_heads, n_layers, vocab, seq = 768, 12, 12, 30522, 512
-    batch = 8  # per-chip; measured sweep (B in {8..64}): throughput on v5e
-    #            *decreases* with batch for this model, so 8 is the honest
-    #            best, not a trick
+    # The canonical BERT-base SQuAD recipe trains at global batch 32; on
+    # v5e that's 4 micro-batches of 8 per optimizer step (grad_accum) —
+    # micro-batch 8 is the measured best-fusing size, and accumulation
+    # amortizes the optimizer's full f32 param/moment sweep (profiled at
+    # ~26% of a step) over 4 micro-batches.  Both knobs overridable for
+    # sweeps: BENCH_BERT_BATCH (per-micro), BENCH_BERT_ACCUM.
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "8"))
+    accum = int(os.environ.get("BENCH_BERT_ACCUM", "4"))
 
     class Encoder(nn.Module):
         def forward(self, scope, ids):
@@ -174,13 +179,19 @@ def bench_bert() -> None:
             for i in range(n_layers):
                 x = scope.child(nn.TransformerLayer(n_heads), x,
                                 name=f"block{i}")
-            # head matmul in bf16 (f32 accumulation inside Dense); the loss
-            # upcasts logits to f32 for the softmax
+            # head matmul in bf16 (f32 accumulation inside Dense); the
+            # loss upcasts logits to f32 for the softmax.  Measured
+            # negative result (2026-07-31, v5e): the chunked fused-CE head
+            # (ops/fused_xent.fused_softmax_xent, which never materializes
+            # f32 logits) came out SLOWER here — 45.5% MFU at chunk=256
+            # and 44.2% at chunk=1024 vs 53.7% for this plain path — the
+            # scanned f32 dW-accumulator carry (94 MB read+written per
+            # chunk) costs more than the saved logits traffic.
             return scope.child(nn.Dense(vocab), x, name="head")
 
     mesh = init_orca_context("local")
     n_chips, kind, peak = _device_info()
-    global_batch = batch * n_chips
+    global_batch = batch * accum * n_chips
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (global_batch, seq))
@@ -188,7 +199,8 @@ def bench_bert() -> None:
 
     est = Estimator.from_keras(Encoder(),
                                loss="sparse_categorical_crossentropy",
-                               optimizer="adamw", learning_rate=1e-4)
+                               optimizer="adamw", learning_rate=1e-4,
+                               grad_accum=accum)
     feed = as_feed((ids, labels), global_batch, shuffle=False)
     batch_dev = next(feed.epoch(mesh, 0))
     est._ensure_initialized(batch_dev["x"])
@@ -240,7 +252,8 @@ def bench_bert() -> None:
            "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
            "streaming_step_ms": round(1000 * stream_dt / n, 2),
            "device_kind": kind, "peak_bf16_flops": peak,
-           "per_chip_batch": batch, "seq": seq})
+           "per_chip_batch": batch, "grad_accum": accum,
+           "global_batch": global_batch, "seq": seq})
 
 
 # -- resnet50 -----------------------------------------------------------------
@@ -337,10 +350,12 @@ def bench_resnet50() -> None:
     ips = steps * global_batch / dt
 
     # -- phase 2: end-to-end streaming via infeed chunks ------------------
+    n_workers, prefetch = 8, 4  # shared by BOTH feeds: the phase-3 warmup
+    #                             drain must match the measured pipeline
     feed2 = StreamingDataFeed(
         num_samples=(n_chunks + 2) * chunk_steps * global_batch,
         load_sample=load_sample, batch_size=global_batch, shuffle=False,
-        num_workers=8, prefetch_batches=4)
+        num_workers=n_workers, prefetch_batches=prefetch)
     stream_dt, n = _stream_train(est, feed2, mesh, chunk_steps, n_chunks)
     stream_ips = n * global_batch / stream_dt
 
@@ -353,7 +368,6 @@ def bench_resnet50() -> None:
     # steady-state: the queue+workers hold up to num_workers+prefetch
     # completed batches, so drain that many for warmup and time a window
     # several times larger — otherwise pre-staged batches inflate the rate
-    n_workers, prefetch = 8, 4
     warm_batches = n_workers + prefetch
     feed_batches = 4 * warm_batches
     feed3 = StreamingDataFeed(

@@ -62,14 +62,25 @@ def _run_module(path: str) -> dict:
     # Popen + communicate (not subprocess.run): on timeout, run() discards
     # the pipe contents, losing the faulthandler dump this runner exists
     # to surface — communicate()'s second attempt reads what's buffered.
+    # Own session + killpg: several modules spawn grandchildren (2-process
+    # jax.distributed, preemption workers) that inherit the stdout pipe;
+    # killing only pytest would leave communicate() blocked on them.
     proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
     try:
         out, _ = proc.communicate(timeout=timeout)
         rc = proc.returncode
     except subprocess.TimeoutExpired:
-        proc.kill()
-        out, _ = proc.communicate()
+        import signal
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            out, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            out = ""
         rc = -1
         out = (out or "") + f"\n<<runner: module timed out after {timeout}s>>"
     dt = time.perf_counter() - t0

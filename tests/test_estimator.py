@@ -256,3 +256,42 @@ def test_evaluate_shuffled_drop_remainder_exact_coverage():
     got = est.evaluate(shuffled, batch_size=16)
     assert got["loss"] == pytest.approx(exact["loss"], rel=1e-5)
     assert got["accuracy"] == pytest.approx(exact["accuracy"], rel=1e-6)
+
+
+def test_grad_accum_matches_full_batch_step():
+    """grad_accum=N must produce EXACTLY the full-batch update: mean of
+    equal micro-batch mean-gradients == full-batch mean gradient."""
+    import analytics_zoo_tpu.nn as nn
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = rng.integers(0, 3, 16).astype(np.int32)
+
+    def make(accum):
+        init_orca_context("local")
+        model = nn.Sequential([nn.Dense(16, activation="relu"),
+                               nn.Dense(3)])
+        est = Estimator.from_keras(
+            model, loss="sparse_categorical_crossentropy", optimizer="sgd",
+            learning_rate=0.1, grad_accum=accum)
+        hist = est.fit((x, y), epochs=2, batch_size=16, verbose=False)
+        return hist["loss"], est.get_model()
+
+    import jax
+    loss1, p1 = make(1)
+    loss4, p4 = make(4)
+    np.testing.assert_allclose(loss1, loss4, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    import analytics_zoo_tpu.nn as nn
+    init_orca_context("local")
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(2)]),
+                               loss="mse", optimizer="sgd",
+                               learning_rate=0.1, grad_accum=3)
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 2), np.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        est.fit((x, y), epochs=1, batch_size=8, verbose=False)

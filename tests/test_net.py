@@ -630,3 +630,26 @@ def test_fx_densenet_style_channel_concat():
         want = m(torch.as_tensor(x)).numpy()
     net = Net.load_torch_graph(m, x)
     np.testing.assert_allclose(_apply(net, x), want, atol=1e-5)
+
+
+def test_load_torch_rejects_flattened_plus_constant_chain():
+    """Regression (r4 review): a non-scalar constant reaching an
+    elementwise op with a flattened NCHW map must raise even when routed
+    through intermediate ops (here: buffer * 2.0), not only as a direct
+    get_attr operand — the element orders differ silently otherwise."""
+    init_orca_context("local")
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(2, 3, 3)
+            self.register_buffer("c", torch.randn(3 * 4 * 4))
+
+        def forward(self, x):
+            f = torch.flatten(self.conv(x), 1)
+            return f + self.c * 2.0
+
+    x = np.random.default_rng(0).normal(size=(2, 2, 6, 6)).astype(
+        np.float32)
+    with pytest.raises(NotImplementedError, match="constant"):
+        Net.load_torch(M().eval(), x)

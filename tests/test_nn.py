@@ -368,3 +368,19 @@ def test_batchnorm_bf16_badly_centered_channels():
                        np.asarray(out32).ravel())[0, 1]
     assert corr > 0.99, corr
     assert float(np.abs(np.asarray(out32).mean())) < 1e-3
+
+
+def test_batchnorm_badly_centered_channels():
+    """Regression (r4 review): single-pass variance must not cancel for
+    channels with |mean| >> std — the shifted-moments formulation keeps
+    f32 precision where raw E[x^2]-E[x]^2 collapses."""
+    rng = np.random.default_rng(0)
+    x = (1e4 + rng.normal(size=(64, 8)).astype(np.float32))
+    bn = nn.BatchNormalization()
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x),
+                        training=True)
+    out, state = bn.apply(variables, jnp.asarray(x), training=True)
+    out = np.asarray(out, np.float32)
+    # normalized output: ~zero mean, ~unit std per channel
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-2)
+    np.testing.assert_allclose(out.std(0), 1.0, atol=0.05)

@@ -81,3 +81,56 @@ def test_flash_under_jit_and_mha_layer(rng):
     variables = mha.init(jax.random.PRNGKey(0), x)
     out, _ = jax.jit(lambda v, x: mha.apply(v, x))(variables, x)
     assert out.shape == (2, 16, 32)
+
+
+def test_fused_softmax_xent_matches_naive():
+    """Loss value AND all three gradients must match the materialized
+    logits path (chunked recompute is numerics-preserving in f32)."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import fused_softmax_xent
+    rng = np.random.default_rng(0)
+    B, S, D, V = 2, 8, 16, 50
+    h = rng.normal(size=(B, S, D)).astype(np.float32)
+    w = (rng.normal(size=(D, V)) * 0.1).astype(np.float32)
+    labels = rng.integers(0, V, (B, S))
+
+    bias = (rng.normal(size=(V,)) * 0.1).astype(np.float32)
+
+    def naive(h, w, bias):
+        logits = (h @ w).astype(jnp.float32) + bias
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        corr = jnp.take_along_axis(
+            logits, jnp.asarray(labels)[..., None], axis=-1)[..., 0]
+        return (lse - corr).mean()
+
+    def fused(h, w, bias):
+        return fused_softmax_xent(h, w, jnp.asarray(labels), 4, bias=bias)
+
+    ln, gn = jax.value_and_grad(naive, argnums=(0, 1, 2))(h, w, bias)
+    lf, gf = jax.value_and_grad(fused, argnums=(0, 1, 2))(h, w, bias)
+    np.testing.assert_allclose(float(lf), float(ln), rtol=1e-6)
+    for a, b in zip(gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_softmax_xent_bf16_close():
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import fused_softmax_xent
+    rng = np.random.default_rng(1)
+    h = rng.normal(size=(1, 16, 8)).astype(np.float32)
+    w = (rng.normal(size=(8, 30)) * 0.2).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 30, (1, 16)))
+    lf32 = fused_softmax_xent(jnp.asarray(h), jnp.asarray(w), labels, 8)
+    lbf = fused_softmax_xent(jnp.asarray(h, jnp.bfloat16),
+                             jnp.asarray(w, jnp.bfloat16), labels, 8)
+    np.testing.assert_allclose(float(lbf), float(lf32), rtol=3e-2)
+
+
+def test_fused_softmax_xent_rejects_bad_chunk():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import fused_softmax_xent
+    with pytest.raises(ValueError, match="divisible"):
+        fused_softmax_xent(jnp.zeros((2, 5, 4)), jnp.zeros((4, 7)),
+                           jnp.zeros((2, 5), jnp.int32), 3)

@@ -426,3 +426,20 @@ def test_inference_model_reload_and_int8_dtype_spellings():
     assert not np.allclose(out, 0.0)  # int8 CAST would zero the weights
     denom = np.maximum(np.abs(ref), 1.0)
     assert np.max(np.abs(out - ref) / denom) < 0.08
+
+
+def test_calibrate_without_int8_raises():
+    """Regression (r4 review): a calibration batch with a non-int8 dtype
+    must error, not be silently ignored."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    init_orca_context("local")
+    m = nn.Sequential([nn.Dense(4)])
+    x = np.zeros((2, 3), np.float32)
+    v = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    with pytest.raises(ValueError, match="calibrate"):
+        InferenceModel().load(m, v, calibrate=x)
+    with pytest.raises(ValueError, match="calibrate"):
+        InferenceModel().load(m, v, dtype=jnp.bfloat16, calibrate=x)
