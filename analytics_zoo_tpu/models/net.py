@@ -234,6 +234,26 @@ class Net:
         return ForeignNet(stages, {"params": params, "state": state},
                           source="tf")
 
+    @staticmethod
+    def load_keras(model_or_path: Any,
+                   weights_path: Optional[str] = None) -> ForeignNet:
+        """Reference parity (SURVEY.md §2.3 Net loaders): the reference's
+        ``Net.load_keras(def_path, weights_path)`` took a Keras
+        architecture-JSON definition plus an optional separate HDF5
+        weights file.  Accepts that form (``.json`` def + weights), a
+        single ``.h5``/``.keras``/SavedModel path, or a live keras model
+        object; conversion itself is the ``load_tf`` path."""
+        import tensorflow as tf
+        model = model_or_path
+        if isinstance(model, str) and model.endswith(".json"):
+            with open(model) as f:
+                model = tf.keras.models.model_from_json(f.read())
+        elif isinstance(model, str):
+            model = tf.keras.models.load_model(model)
+        if weights_path is not None:
+            model.load_weights(weights_path)
+        return Net.load_tf(model)
+
     # -- consciously dropped formats ------------------------------------------
 
     @staticmethod

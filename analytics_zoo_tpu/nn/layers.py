@@ -363,22 +363,26 @@ class BatchNormalization(Module):
             mean, var = mean_run, var_run
         shape = [1] * x.ndim
         shape[self.axis] = dim
-        # Mean-centered form in the ACTIVATION dtype: (x - mean) is a
-        # cancellation-safe subtraction of nearby values, after which the
-        # scale/shift multiply is well-conditioned in bf16.  (The earlier
-        # x*inv + shift form needed f32 — x*inv and shift can be huge and
-        # cancel — but its f32 output forced every BN backward pass into
-        # f32 elementwise kernels: 2x the HBM bytes of bf16 on a
-        # bandwidth-bound model.)  Statistics stay f32.
+        # Mean-centered form: the centering subtraction happens in f32
+        # (x upcast in-registers, minus the exact f32 mean) and only the
+        # RESULT is downcast, so badly centered channels (|mean| >> std)
+        # lose nothing to a rounded-mean bias; the remaining scale/shift
+        # multiply is well-conditioned in bf16.  (The earlier
+        # x*inv + shift form needed f32 throughout — x*inv and shift can
+        # be huge and cancel — but its f32 output forced every BN
+        # backward pass into f32 elementwise kernels: 2x the HBM bytes
+        # of bf16 on a bandwidth-bound model.  The f32 here is
+        # register-only inside the fused elementwise; HBM traffic stays
+        # bf16.)  Statistics stay f32.
         inv = jax.lax.rsqrt(var + self.epsilon)
         if self.scale:
             inv = inv * scope.param("gamma", initializers.get("ones"),
                                     (dim,))
         beta = (scope.param("beta", initializers.get("zeros"), (dim,))
                 if self.center else None)
-        mean_c = mean.astype(x.dtype).reshape(shape)
         inv_c = inv.astype(x.dtype).reshape(shape)
-        y = (x - mean_c) * inv_c
+        y = (x.astype(jnp.float32) - mean.reshape(shape)).astype(x.dtype)
+        y = y * inv_c
         if beta is not None:
             y = y + beta.astype(x.dtype).reshape(shape)
         return y

@@ -33,6 +33,14 @@ class Calibrator:
     mode = "collect"
 
     def observe(self, path: Tuple[str, ...], x: jax.Array) -> None:
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError(
+                "int8 calibration must run UNJITTED: the Calibrator reads "
+                "concrete activation ranges back to the host, which is "
+                "impossible under jit/scan/vmap tracing (layer "
+                f"{'/'.join(path)} saw a tracer). Run the calibration "
+                "forward outside jax.jit — InferenceModel.load("
+                "calibrate=batch) does this for you.")
         key = "/".join(path)
         val = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
         self.amax[key] = max(self.amax.get(key, 0.0), val)

@@ -723,11 +723,12 @@ def main() -> None:
         return
     if args.config != "all":
         sys.exit(_run_child(args.config, args.attempts))
-    # Full matrix: bert (the headline) first, then the rest.  Exit 0 iff
-    # both MFU-bar configs (bert, resnet50) produced real numbers; a skip
-    # record elsewhere documents itself in the evidence file.
+    # Full matrix.  Exit 0 only if EVERY config produced a real number —
+    # a CI consumer checking just the return code must not miss a
+    # persistently failing config; the per-config skip records on stdout
+    # carry the reason for any non-zero exit.
     failed = {c for c in CONFIGS if _run_child(c, args.attempts) != 0}
-    sys.exit(1 if failed & {"bert", "resnet50"} else 0)
+    sys.exit(1 if failed else 0)
 
 
 if __name__ == "__main__":

@@ -653,3 +653,36 @@ def test_load_torch_rejects_flattened_plus_constant_chain():
         np.float32)
     with pytest.raises(NotImplementedError, match="constant"):
         Net.load_torch(M().eval(), x)
+
+
+def test_load_keras_named_entry_point():
+    """Reference parity (SURVEY.md §2.3 Net loaders): ``Net.load_keras``
+    must exist as a named entry point, routing to the tf.keras
+    conversion path."""
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(4, activation="relu"),
+        tf.keras.layers.Dense(2)])
+    x = np.random.default_rng(1).normal(size=(3, 6)).astype(np.float32)
+    net = Net.load_keras(km)
+    np.testing.assert_allclose(_apply(net, x), km(x).numpy(), atol=1e-5)
+
+
+def test_load_keras_json_def_plus_weights(tmp_path):
+    """Reference call form: ``Net.load_keras(def_json, weights_h5)`` —
+    architecture JSON + separate weights file."""
+    tf = pytest.importorskip("tensorflow")
+    init_orca_context("local")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((5,)),
+        tf.keras.layers.Dense(3, activation="tanh"),
+        tf.keras.layers.Dense(2)])
+    d = tmp_path / "def.json"
+    w = tmp_path / "weights.weights.h5"
+    d.write_text(km.to_json())
+    km.save_weights(str(w))
+    x = np.random.default_rng(2).normal(size=(4, 5)).astype(np.float32)
+    net = Net.load_keras(str(d), str(w))
+    np.testing.assert_allclose(_apply(net, x), km(x).numpy(), atol=1e-5)

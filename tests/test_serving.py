@@ -443,3 +443,21 @@ def test_calibrate_without_int8_raises():
         InferenceModel().load(m, v, calibrate=x)
     with pytest.raises(ValueError, match="calibrate"):
         InferenceModel().load(m, v, dtype=jnp.bfloat16, calibrate=x)
+
+
+def test_calibrator_rejects_traced_forward():
+    """Regression (r4 advisor): running the calibration forward under
+    jit must fail with an actionable message, not an opaque
+    TracerError deep inside float()."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.nn.quant import Calibrator
+
+    calib = Calibrator()
+
+    def f(x):
+        calib.observe(("dense",), x)
+        return x
+
+    with pytest.raises(RuntimeError, match="UNJITTED"):
+        jax.jit(f)(jnp.ones((2, 2)))
