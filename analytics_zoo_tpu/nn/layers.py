@@ -363,29 +363,28 @@ class BatchNormalization(Module):
             mean, var = mean_run, var_run
         shape = [1] * x.ndim
         shape[self.axis] = dim
-        # Mean-centered form: the centering subtraction happens in f32
-        # (x upcast in-registers, minus the exact f32 mean) and only the
-        # RESULT is downcast, so badly centered channels (|mean| >> std)
-        # lose nothing to a rounded-mean bias; the remaining scale/shift
-        # multiply is well-conditioned in bf16.  (The earlier
-        # x*inv + shift form needed f32 throughout — x*inv and shift can
-        # be huge and cancel — but its f32 output forced every BN
-        # backward pass into f32 elementwise kernels: 2x the HBM bytes
-        # of bf16 on a bandwidth-bound model.  The f32 here is
-        # register-only inside the fused elementwise; HBM traffic stays
-        # bf16.)  Statistics stay f32.
+        # Mean-centered form with a rounding-compensated shift, all
+        # per-ELEMENT math in the activation dtype.  (x - mean) of nearby
+        # bf16 values is cancellation-safe (Sterbenz), and keeping the
+        # elementwise chain bf16 keeps every BN fwd/bwd kernel at bf16
+        # HBM bytes — an f32 upcast here measures ~6% of a whole RN50
+        # train step.  The one hazard of a bf16 mean — rounding it
+        # injects a per-channel bias of up to (|mean|/std)*2^-9 sigma —
+        # is cancelled exactly by folding the f32 rounding residual
+        # (mean_rounded - mean) * inv into the per-CHANNEL shift, which
+        # costs C scalar flops.  Statistics stay f32 throughout.
         inv = jax.lax.rsqrt(var + self.epsilon)
         if self.scale:
             inv = inv * scope.param("gamma", initializers.get("ones"),
                                     (dim,))
-        beta = (scope.param("beta", initializers.get("zeros"), (dim,))
-                if self.center else None)
+        mean_c = mean.astype(x.dtype)
+        shift = (mean_c.astype(jnp.float32) - mean) * inv
+        if self.center:
+            shift = shift + scope.param("beta", initializers.get("zeros"),
+                                        (dim,))
         inv_c = inv.astype(x.dtype).reshape(shape)
-        y = (x.astype(jnp.float32) - mean.reshape(shape)).astype(x.dtype)
-        y = y * inv_c
-        if beta is not None:
-            y = y + beta.astype(x.dtype).reshape(shape)
-        return y
+        y = (x - mean_c.reshape(shape)) * inv_c
+        return y + shift.astype(x.dtype).reshape(shape)
 
 
 class LayerNormalization(Module):

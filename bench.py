@@ -2,10 +2,20 @@
 
 Prints ONE JSON line per config: {"metric", "value", "unit",
 "vs_baseline"}.  Plain ``python bench.py`` (what the driver runs) measures
-the FULL BASELINE matrix — bert first (the headline line), then resnet50,
-lenet, ncf, autots, scaling — sequentially, each in a retrying child
-process; a config whose retries are exhausted emits a skip record with the
-reason instead of silently vanishing from the evidence.
+the FULL BASELINE matrix — cheap configs first (lenet, ncf, autots,
+scaling), then the two MFU headline configs (resnet50, bert) LAST so the
+driver's stdout-tail capture can never truncate them — sequentially, each
+in a retrying child process; a config whose retries are exhausted emits a
+skip record with the reason instead of silently vanishing from the
+evidence.
+
+Reproducibility (VERDICT r4 task 2): the resident timing runs K=3 repeats
+— headline = best repeat, `detail.{step_ms_median, rel_spread}` quantify
+the window; the parent re-runs a config whose spread exceeds 10% and marks
+the final record `contended: true` if no clean window appears.  The
+tunnel-exposed streaming phase retries independently inside the child
+(up to 3x, best kept, `streaming_contended` if it never reaches 85% of
+resident).
 
 Configs (BASELINE.md table; select one with ``--config``, default all):
   bert      BERT-base MLM fine-tune — tokens/sec/chip + MFU, measured BOTH
@@ -62,7 +72,11 @@ _PEAK_BF16 = [
     ("v2", 45e12),
 ]
 
-CONFIGS = ("bert", "resnet50", "lenet", "ncf", "autots", "scaling")
+# Cheap configs first, the two MFU headline configs LAST: the driver
+# records only the tail of stdout, so the records that carry the
+# acceptance-bar evidence must be the final lines (the round-4 artifact
+# lost the opening of its first-printed record to tail truncation).
+CONFIGS = ("lenet", "ncf", "autots", "scaling", "resnet50", "bert")
 
 
 def peak_flops_per_chip() -> float:
@@ -120,6 +134,24 @@ def _put_chunk(tree, mesh):
         return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
 
     return {k: put(v) for k, v in tree.items()}
+
+
+def _timed_repeats(run_once, repeats=3):
+    """Run a blocking measurement `repeats` times; report best + spread.
+
+    Even device-RESIDENT steps drift ~15% with tunnel weather on this
+    shared chip (memory: same code, 52.4 -> 61 ms across hours), so a
+    single timing cannot distinguish the code's speed from the window's
+    congestion.  Convention (VERDICT r4 task 2): headline = best repeat
+    (closest to the code's true speed); `rel_spread` = (max-min)/median
+    quantifies the window; the parent re-runs the config when the spread
+    exceeds ~10% and marks the record `contended` if it never settles.
+    """
+    dts = [run_once() for _ in range(repeats)]
+    s = sorted(dts)
+    best, median = s[0], s[len(s) // 2]
+    rel_spread = (s[-1] - s[0]) / median if median > 0 else 0.0
+    return best, median, rel_spread
 
 
 def _stream_train(est, feed, mesh, chunk_steps, n_chunks):
@@ -206,21 +238,27 @@ def bench_bert() -> None:
     est._ensure_initialized(batch_dev["x"])
 
     # -- phase 1: device-resident batch (pure-compute MFU) --------------------
-    steps = 50
+    steps, repeats = 50, 3
     # warmup: compiles the K-step executable and runs it once
     est._ts, warm_losses = est._multi_step(est._ts, batch_dev, steps)
     _ = float(warm_losses[-1])
 
-    t0 = time.perf_counter()
-    est._ts, losses = est._multi_step(est._ts, batch_dev, steps)
-    _ = float(losses[-1])  # host transfer: the synchronization point
-    dt = time.perf_counter() - t0
+    def run_resident():
+        t0 = time.perf_counter()
+        est._ts, losses = est._multi_step(est._ts, batch_dev, steps)
+        _ = float(losses[-1])  # host transfer: the synchronization point
+        return time.perf_counter() - t0
+
+    dt, dt_median, rel_spread = _timed_repeats(run_resident, repeats)
     resident_tps = steps * global_batch * seq / dt
 
     # -- phase 2: end-to-end from the streaming input pipeline ----------------
     # Fresh host batches every step: worker threads assemble token batches,
     # push through the bounded native queue; the consumer stacks K batches
     # into one infeed-chunk transfer + one K-step scan (_stream_train).
+    # The host->device hop rides the shared tunnel, so a congested minute
+    # can crater ONLY this phase: retry it (not the whole config) until it
+    # lands within 15% of resident or the budget is spent, keep the best.
     chunk_steps, n_chunks = 10, 3
 
     def load_sample(i: int, rng=None) -> dict:
@@ -228,12 +266,19 @@ def bench_bert() -> None:
         return {"x": r.integers(0, vocab, (seq,)),
                 "y": r.integers(0, vocab, (seq,))}
 
-    sfeed = StreamingDataFeed(
-        num_samples=(n_chunks + 2) * chunk_steps * global_batch,
-        load_sample=load_sample, batch_size=global_batch, shuffle=False,
-        num_workers=8, prefetch_batches=4)
-    stream_dt, n = _stream_train(est, sfeed, mesh, chunk_steps, n_chunks)
-    stream_tps = n * global_batch * seq / stream_dt
+    stream_tps, stream_dt_per_step, stream_attempts = 0.0, 0.0, 0
+    for _ in range(3):
+        stream_attempts += 1
+        sfeed = StreamingDataFeed(
+            num_samples=(n_chunks + 2) * chunk_steps * global_batch,
+            load_sample=load_sample, batch_size=global_batch, shuffle=False,
+            num_workers=8, prefetch_batches=4)
+        s_dt, n = _stream_train(est, sfeed, mesh, chunk_steps, n_chunks)
+        tps = n * global_batch * seq / s_dt
+        if tps > stream_tps:
+            stream_tps, stream_dt_per_step = tps, s_dt / n
+        if stream_tps >= 0.85 * resident_tps:
+            break
 
     fpt = flops_per_token(d_model, n_layers, seq, vocab)
     if peak > 0:
@@ -242,15 +287,22 @@ def bench_bert() -> None:
         vs_baseline = mfu / 0.40
     else:
         mfu = stream_mfu = vs_baseline = 0.0  # CPU sim: no MFU claim
+    ratio = stream_tps / resident_tps
     _emit("bert_base_train_tokens_per_sec_per_chip",
           resident_tps / n_chips, "tokens/s/chip", vs_baseline,
           {"mfu": round(mfu, 4),
            "streaming_mfu": round(stream_mfu, 4),
            "streaming_tokens_per_sec_per_chip":
                round(stream_tps / n_chips, 1),
-           "streaming_over_resident": round(stream_tps / resident_tps, 4),
+           "streaming_over_resident": round(ratio, 4),
+           "streaming_attempts": stream_attempts,
+           **({"streaming_contended": True} if ratio < 0.85 else {}),
+           "repeats": repeats,
+           "step_ms_best": round(1000 * dt / steps, 2),
+           "step_ms_median": round(1000 * dt_median / steps, 2),
+           "rel_spread": round(rel_spread, 4),
            "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
-           "streaming_step_ms": round(1000 * stream_dt / n, 2),
+           "streaming_step_ms": round(1000 * stream_dt_per_step, 2),
            "device_kind": kind, "peak_bf16_flops": peak,
            "per_chip_batch": batch, "grad_accum": accum,
            "global_batch": global_batch, "seq": seq})
@@ -340,24 +392,38 @@ def bench_resnet50() -> None:
 
     # -- phase 1: device-resident batch (pure-compute MFU, the headline;
     # stable against the device tunnel's transfer-throughput swings) ------
-    steps = 20
+    steps, repeats = 20, 3
     est._ts, warm = est._multi_step(est._ts, b0, steps)
     _ = float(warm[-1])
-    t0 = time.perf_counter()
-    est._ts, losses = est._multi_step(est._ts, b0, steps)
-    _ = float(losses[-1])
-    dt = time.perf_counter() - t0
+
+    def run_resident():
+        t0 = time.perf_counter()
+        est._ts, losses = est._multi_step(est._ts, b0, steps)
+        _ = float(losses[-1])
+        return time.perf_counter() - t0
+
+    dt, dt_median, rel_spread = _timed_repeats(run_resident, repeats)
     ips = steps * global_batch / dt
 
     # -- phase 2: end-to-end streaming via infeed chunks ------------------
+    # Tunnel-exposed: retry JUST this phase until it lands within 15% of
+    # resident or the budget is spent; keep the best attempt (VERDICT r4
+    # task 8 — four rounds never caught RN50 streaming in a clean window).
     n_workers, prefetch = 8, 4  # shared by BOTH feeds: the phase-3 warmup
     #                             drain must match the measured pipeline
-    feed2 = StreamingDataFeed(
-        num_samples=(n_chunks + 2) * chunk_steps * global_batch,
-        load_sample=load_sample, batch_size=global_batch, shuffle=False,
-        num_workers=n_workers, prefetch_batches=prefetch)
-    stream_dt, n = _stream_train(est, feed2, mesh, chunk_steps, n_chunks)
-    stream_ips = n * global_batch / stream_dt
+    stream_ips, stream_dt_per_step, stream_attempts = 0.0, 0.0, 0
+    for _ in range(3):
+        stream_attempts += 1
+        feed2 = StreamingDataFeed(
+            num_samples=(n_chunks + 2) * chunk_steps * global_batch,
+            load_sample=load_sample, batch_size=global_batch, shuffle=False,
+            num_workers=n_workers, prefetch_batches=prefetch)
+        s_dt, n = _stream_train(est, feed2, mesh, chunk_steps, n_chunks)
+        cur = n * global_batch / s_dt
+        if cur > stream_ips:
+            stream_ips, stream_dt_per_step = cur, s_dt / n
+        if stream_ips >= 0.85 * ips:
+            break
 
     # -- phase 3: host-side feed-only throughput --------------------------
     # The streaming number above depends on the shared device tunnel's
@@ -389,17 +455,24 @@ def bench_resnet50() -> None:
         vs_baseline = mfu / 0.40
     else:
         mfu = stream_mfu = vs_baseline = 0.0
+    ratio = stream_ips / ips
     _emit("resnet50_train_images_per_sec_per_chip", ips / n_chips,
           "images/s/chip", vs_baseline,
           {"mfu": round(mfu, 4), "streaming_mfu": round(stream_mfu, 4),
            "streaming_images_per_sec_per_chip":
                round(stream_ips / n_chips, 1),
-           "streaming_over_resident": round(stream_ips / ips, 4),
+           "streaming_over_resident": round(ratio, 4),
+           "streaming_attempts": stream_attempts,
+           **({"streaming_contended": True} if ratio < 0.85 else {}),
+           "repeats": repeats,
+           "step_ms_best": round(1000 * dt / steps, 2),
+           "step_ms_median": round(1000 * dt_median / steps, 2),
+           "rel_spread": round(rel_spread, 4),
            "host_feed_images_per_sec": round(host_feed_ips, 1),
            "host_feed_batches_per_sec":
                round(host_feed_ips / global_batch, 3),
            "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
-           "streaming_step_ms": round(1000 * stream_dt / n, 2),
+           "streaming_step_ms": round(1000 * stream_dt_per_step, 2),
            "fwd_gflops_per_image": round(flops_per_image / 1e9, 3),
            "device_kind": kind, "peak_bf16_flops": peak,
            "per_chip_batch": batch, "image_size": size,
@@ -654,6 +727,7 @@ def _run_child(config: str, attempts: int | None = None) -> int:
                             + " --xla_force_host_platform_device_count=8")
         env["BENCH_FORCE_CPU"] = "1"
     last_reason = "unknown"
+    best_contended = None  # best over-spread record seen, if none settles
     for attempt in range(1, attempts + 1):
         try:
             proc = subprocess.run(
@@ -672,18 +746,41 @@ def _run_child(config: str, attempts: int | None = None) -> int:
                 time.sleep(delay)
                 delay *= 3
             continue
-        line = None
+        line = parsed = None
         for ln in reversed(proc.stdout.splitlines()):
             ln = ln.strip()
             if ln.startswith("{"):
                 try:
-                    parsed = json.loads(ln)
+                    cand = json.loads(ln)
                 except json.JSONDecodeError:
                     continue
-                if "metric" in parsed and "vs_baseline" in parsed:
-                    line = ln
+                if "metric" in cand and "vs_baseline" in cand:
+                    line, parsed = ln, cand
                     break
         if proc.returncode == 0 and line is not None:
+            # Variance guard: a repeat spread >10% on the resident timing
+            # means the measurement window was congested — the number may
+            # be the tunnel's, not the code's.  Spend remaining attempts
+            # on a cleaner window; keep the best (fastest) contended
+            # record as the fallback, marked as such.
+            spread = float(parsed.get("detail", {}).get("rel_spread", 0.0))
+            if spread > 0.10 and attempt < attempts:
+                if (best_contended is None
+                        or parsed["value"] > best_contended["value"]):
+                    best_contended = parsed
+                sys.stderr.write(
+                    f"bench[{config}] attempt {attempt}/{attempts}: "
+                    f"rel_spread={spread:.3f} > 0.10 (contended window); "
+                    f"retrying for a cleaner one\n")
+                time.sleep(delay)
+                delay *= 3
+                continue
+            if spread > 0.10:
+                if (best_contended is not None
+                        and best_contended["value"] > parsed["value"]):
+                    parsed = best_contended
+                parsed["detail"]["contended"] = True
+                line = json.dumps(parsed)
             print(line, flush=True)
             return 0
         tail = "; ".join(proc.stderr.splitlines()[-3:])
@@ -695,6 +792,13 @@ def _run_child(config: str, attempts: int | None = None) -> int:
         if attempt < attempts:
             time.sleep(delay)
             delay *= 3
+    if best_contended is not None:
+        # A real (if contended) measurement beats a skip record: if the
+        # retries spent hunting a cleaner window hard-failed, fall back
+        # to the evidence we already hold.
+        best_contended["detail"]["contended"] = True
+        print(json.dumps(best_contended), flush=True)
+        return 0
     _emit(f"{config}_skipped", 0.0, "skipped", 0.0,
           {"skipped": f"all {attempts} attempts failed; last: {last_reason}"})
     return 1

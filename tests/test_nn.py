@@ -368,11 +368,12 @@ def test_batchnorm_bf16_badly_centered_channels():
                        np.asarray(out32).ravel())[0, 1]
     assert corr > 0.99, corr
     assert float(np.abs(np.asarray(out32).mean())) < 1e-3
-    # r4 advisor: centering must subtract the EXACT f32 mean before any
-    # downcast.  A mean rounded to bf16 first would inject a
-    # deterministic per-channel bias of up to (|mean|/std)*2^-9 sigma
-    # (~0.02 here); exact centering leaves only zero-mean rounding noise
-    # from downcasting the already-centered values (~5e-4).
+    # r4 advisor: the bf16-rounded mean's bias must be COMPENSATED.  An
+    # uncompensated bf16 mean injects a deterministic per-channel bias
+    # of up to (|mean|/std)*2^-9 sigma (~0.02 here); the implementation
+    # may center however it likes (exact f32 subtract, or the faster
+    # bf16 subtract + f32 rounding-residual folded into the shift) as
+    # long as the residual bias stays at rounding-noise level (~5e-4).
     ch_bias = np.abs(np.asarray(out16, np.float32).mean(axis=0))
     assert float(ch_bias.max()) < 5e-3, ch_bias
 
