@@ -42,10 +42,12 @@ class _SpaceToDepthStem(Module):
     """
 
     def __init__(self, filters: int, kernel_init: Any = "he_normal",
+                 weight_standardized: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.filters = filters
         self.kernel_init = kernel_init
+        self.weight_standardized = weight_standardized
 
     def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
         b, h, w, c = x.shape
@@ -54,7 +56,12 @@ class _SpaceToDepthStem(Module):
                              f"{x.shape}")
         f = self.filters
         k = scope.param("kernel", nn.initializers.get(self.kernel_init),
-                        (7, 7, c, f)).astype(x.dtype)
+                        (7, 7, c, f))
+        if self.weight_standardized:  # NF variant: see ScaledWSConv2D
+            gain = scope.param("ws_gain", nn.initializers.get("ones"),
+                               (f,))
+            k = nn.layers.scaled_ws_kernel(k, gain)
+        k = k.astype(x.dtype)
         k8 = jnp.pad(k, ((0, 1), (0, 1), (0, 0), (0, 0)))
         k2 = (k8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
               .reshape(4, 4, 4 * c, f))
@@ -65,6 +72,72 @@ class _SpaceToDepthStem(Module):
         return jax.lax.conv_general_dilated(
             x2, k2, window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+_NF_RELU_GAIN = 1.7139588594436646  # sqrt(2 / (1 - 1/pi)): relu VP gain
+
+
+class _NFResBlock(Module):
+    """Normalizer-free bottleneck block (public technique: Brock et al.
+    2021, NF-ResNet): pre-activation ``h = x + alpha * f(relu(x) *
+    gain / beta)`` with Scaled WS convs inside f, a zero-initialised
+    learnable scalar on the residual branch (SkipInit), and analytically
+    tracked input std ``beta``.  No activation statistics are ever
+    reduced — normalization lives in weight space (see ScaledWSConv2D),
+    which on TPU removes batch norm's full feature-map reduction
+    traffic from every training step."""
+
+    def __init__(self, filters: int, stride: int, bottleneck: bool,
+                 beta: float, alpha: float,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.stride = stride
+        self.bottleneck = bottleneck
+        self.beta = beta
+        self.alpha = alpha
+
+    def forward(self, scope: Scope, x: jax.Array) -> jax.Array:
+        f = self.filters
+        out_f = f * 4 if self.bottleneck else f
+        pre = jax.nn.relu(x) * jnp.asarray(
+            _NF_RELU_GAIN / self.beta, x.dtype)
+        transition = x.shape[-1] != out_f or self.stride != 1
+        # Transition shortcuts branch from the SCALED activation (resets
+        # the analytic variance); identity shortcuts keep x itself.
+        shortcut = x
+        if transition:
+            shortcut = scope.child(
+                nn.ScaledWSConv2D(out_f, 1, strides=self.stride,
+                                  use_bias=False),
+                pre, name="proj")
+        # The residual branch's SkipInit scalar (x alpha) is folded into
+        # the LAST conv's weight scale (see ScaledWSConv2D.skip_init):
+        # identical math, but dL/d(skip_gain) is a weight-space adjoint
+        # instead of a full-map scalar reduction.
+        h = pre
+        if self.bottleneck:
+            h = scope.child(nn.ScaledWSConv2D(f, 1, use_bias=False), h,
+                            name="conv1")
+            h = jax.nn.relu(h) * jnp.asarray(_NF_RELU_GAIN, x.dtype)
+            h = scope.child(nn.ScaledWSConv2D(f, 3, strides=self.stride,
+                                              use_bias=False), h,
+                            name="conv2")
+            h = jax.nn.relu(h) * jnp.asarray(_NF_RELU_GAIN, x.dtype)
+            h = scope.child(nn.ScaledWSConv2D(out_f, 1, use_bias=False,
+                                              skip_init=True,
+                                              branch_scale=self.alpha),
+                            h, name="conv3")
+        else:
+            h = scope.child(nn.ScaledWSConv2D(f, 3, strides=self.stride,
+                                              use_bias=False), h,
+                            name="conv1")
+            h = jax.nn.relu(h) * jnp.asarray(_NF_RELU_GAIN, x.dtype)
+            h = scope.child(nn.ScaledWSConv2D(f, 3, use_bias=False,
+                                              skip_init=True,
+                                              branch_scale=self.alpha),
+                            h, name="conv2")
+        return shortcut + h
 
 
 class _ResBlock(Module):
@@ -112,16 +185,19 @@ class ResNet(ZooModel):
     def __init__(self, depth: int = 50, class_num: int = 1000,
                  width: int = 64, include_top: bool = True,
                  return_stages: bool = False, dtype: str = "float32",
-                 stem: str = "conv"):
+                 stem: str = "conv", norm: str = "batch"):
         super().__init__()
         self._config = dict(depth=depth, class_num=class_num, width=width,
                             include_top=include_top,
                             return_stages=return_stages, dtype=dtype,
-                            stem=stem)
+                            stem=stem, norm=norm)
         if depth not in _SPECS:
             raise ValueError(f"depth must be one of {sorted(_SPECS)}")
         if stem not in ("conv", "space_to_depth"):
             raise ValueError("stem must be 'conv' or 'space_to_depth'")
+        if norm not in ("batch", "nf"):
+            raise ValueError("norm must be 'batch' (classic BN ResNet) "
+                             "or 'nf' (normalizer-free, Scaled WS convs)")
         self.depth = depth
         self.class_num = class_num
         self.width = width
@@ -129,34 +205,63 @@ class ResNet(ZooModel):
         self.return_stages = return_stages
         self.dtype = dtype
         self.stem = stem
+        self.norm = norm
 
     def forward(self, scope: Scope, x: jax.Array):
         """x: [B, H, W, C] images (NHWC — TPU-native layout; the reference
         used NCHW for MKL-DNN).  return_stages=True yields the per-stage
-        feature maps (stages 1..3) for detection heads."""
+        feature maps (stages 1..3) for detection heads.
+
+        NF tap semantics: with ``norm='nf'`` the stage taps are
+        PRE-activation residual-sum maps whose analytic std grows
+        ~sqrt(1 + k*alpha^2) within a stage (no final relu, no
+        normalization) — unlike the BN path's post-relu normalized taps.
+        A detection head moving between norms should expect differently
+        scaled features (apply its own norm, or relu + rescale)."""
         blocks, bottleneck = _SPECS[self.depth]
+        nf = self.norm == "nf"
         if self.dtype == "bfloat16":
             x = x.astype(jnp.bfloat16)
         if self.stem == "space_to_depth":
-            h = scope.child(_SpaceToDepthStem(self.width), x, name="stem")
+            h = scope.child(
+                _SpaceToDepthStem(self.width, weight_standardized=nf),
+                x, name="stem")
+        elif nf:
+            h = scope.child(nn.ScaledWSConv2D(self.width, 7, strides=2,
+                                              use_bias=False), x,
+                            name="stem")
         else:
             h = scope.child(nn.Conv2D(self.width, 7, strides=2,
                                       use_bias=False), x, name="stem")
-        h = scope.child(nn.BatchNormalization(), h, name="stem_bn")
+        if not nf:
+            h = scope.child(nn.BatchNormalization(), h, name="stem_bn")
         h = jax.nn.relu(h)
         h = scope.child(nn.MaxPooling2D(3, strides=2, padding="same"), h,
                         name="stem_pool")
         taps = []
+        alpha, var = 0.2, 1.0  # NF analytic variance tracking
         for stage, n_blocks in enumerate(blocks):
             f = self.width * (2 ** stage)
             for b in range(n_blocks):
                 stride = 2 if (b == 0 and stage > 0) else 1
-                h = scope.child(_ResBlock(f, stride, bottleneck), h,
-                                name=f"stage{stage}_block{b}")
+                if nf:
+                    transition = b == 0  # channel change or stride 2
+                    h = scope.child(
+                        _NFResBlock(f, stride, bottleneck,
+                                    beta=float(np.sqrt(var)),
+                                    alpha=alpha), h,
+                        name=f"stage{stage}_block{b}")
+                    var = (1.0 if transition else var) + alpha * alpha
+                else:
+                    h = scope.child(_ResBlock(f, stride, bottleneck), h,
+                                    name=f"stage{stage}_block{b}")
             if stage >= 1:
                 taps.append(h)
         if self.return_stages:
             return taps
+        if nf:
+            # NF blocks are pre-activation: one final relu before pooling
+            h = jax.nn.relu(h)
         h = jnp.mean(h, axis=(1, 2))  # global average pool
         if not self.include_top:
             return h

@@ -8,7 +8,7 @@ from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Flatten, GlobalAveragePooling1D, GlobalAveragePooling2D,
                      GlobalMaxPooling1D, GlobalMaxPooling2D, Lambda,
                      LayerNormalization, MaxPooling2D, Multiply, Reshape,
-                     Sequential, ZeroPadding2D)
+                     ScaledWSConv2D, Sequential, ZeroPadding2D)
 from .layers_extra import (AveragePooling1D, AveragePooling3D, Average,
                            Conv2DTranspose, Conv3D, Cropping1D, Cropping2D,
                            Cropping3D, DepthwiseConv2D, Dot, ELU,

@@ -154,6 +154,22 @@ def _timed_repeats(run_once, repeats=3):
     return best, median, rel_spread
 
 
+def _retry_streaming(run_once, resident_rate, attempts=3):
+    """Tunnel-exposed streaming phase: retry JUST this phase until it
+    lands within 15% of the resident rate or the budget is spent; keep
+    the best attempt.  Returns (rate, seconds_per_step, attempts_used).
+    ``run_once`` -> (rate, seconds_per_step)."""
+    best_rate, best_spp, used = 0.0, 0.0, 0
+    for _ in range(attempts):
+        used += 1
+        rate, spp = run_once()
+        if rate > best_rate:
+            best_rate, best_spp = rate, spp
+        if best_rate >= 0.85 * resident_rate:
+            break
+    return best_rate, best_spp, used
+
+
 def _stream_train(est, feed, mesh, chunk_steps, n_chunks):
     """End-to-end streaming training via infeed chunks: K fresh host
     batches -> one device transfer -> one K-step scan executable
@@ -257,8 +273,7 @@ def bench_bert() -> None:
     # push through the bounded native queue; the consumer stacks K batches
     # into one infeed-chunk transfer + one K-step scan (_stream_train).
     # The host->device hop rides the shared tunnel, so a congested minute
-    # can crater ONLY this phase: retry it (not the whole config) until it
-    # lands within 15% of resident or the budget is spent, keep the best.
+    # can crater ONLY this phase: _retry_streaming re-runs it alone.
     chunk_steps, n_chunks = 10, 3
 
     def load_sample(i: int, rng=None) -> dict:
@@ -266,19 +281,16 @@ def bench_bert() -> None:
         return {"x": r.integers(0, vocab, (seq,)),
                 "y": r.integers(0, vocab, (seq,))}
 
-    stream_tps, stream_dt_per_step, stream_attempts = 0.0, 0.0, 0
-    for _ in range(3):
-        stream_attempts += 1
+    def run_stream():
         sfeed = StreamingDataFeed(
             num_samples=(n_chunks + 2) * chunk_steps * global_batch,
             load_sample=load_sample, batch_size=global_batch, shuffle=False,
             num_workers=8, prefetch_batches=4)
         s_dt, n = _stream_train(est, sfeed, mesh, chunk_steps, n_chunks)
-        tps = n * global_batch * seq / s_dt
-        if tps > stream_tps:
-            stream_tps, stream_dt_per_step = tps, s_dt / n
-        if stream_tps >= 0.85 * resident_tps:
-            break
+        return n * global_batch * seq / s_dt, s_dt / n
+
+    stream_tps, stream_dt_per_step, stream_attempts = _retry_streaming(
+        run_stream, resident_tps)
 
     fpt = flops_per_token(d_model, n_layers, seq, vocab)
     if peak > 0:
@@ -298,7 +310,6 @@ def bench_bert() -> None:
            "streaming_attempts": stream_attempts,
            **({"streaming_contended": True} if ratio < 0.85 else {}),
            "repeats": repeats,
-           "step_ms_best": round(1000 * dt / steps, 2),
            "step_ms_median": round(1000 * dt_median / steps, 2),
            "rel_spread": round(rel_spread, 4),
            "chips": n_chips, "step_ms": round(1000 * dt / steps, 2),
@@ -326,16 +337,26 @@ def bench_resnet50() -> None:
     batch = 128  # per-chip; measured sweep (64/128/256 -> 9.8/12.3/12.8%
     #              MFU): 128 is the knee, 256 doubles latency for +4%
 
+    # Two ResNet-50 configs, SAME conv topology / FLOPs:
+    #   nf    — normalizer-free (Scaled WS convs + folded SkipInit,
+    #           models/image.py): the shipped, BENCHMARKED training recipe.
+    #           Batch norm's per-step feature-map statistics traffic is an
+    #           HBM-bandwidth floor (~25 GB/step at B=128 — see
+    #           BASELINE.md's traffic table) that caps exact-BN at ~31%
+    #           MFU on v5e; weight-space normalization removes it.
+    #   batch — classic exact-BN ResNet-50, measured back-to-back in the
+    #           SAME window and reported in detail.bn_* for the honest
+    #           comparison (it remains the default ResNet(norm="batch")).
     class TrainNet(nn.Module):
         """uint8 NHWC images -> on-device normalize -> bf16 ResNet-50.
         uint8 payload: 4x less host->device traffic than f32."""
 
-        def __init__(self):
+        def __init__(self, norm: str):
             super().__init__()
             # space-to-depth stem: the 7x7/s2 C=3 conv recast as a dense
             # 4x4/s1 C=12 conv (numerically identical; see models/image.py)
             self.net = ResNet(depth=50, class_num=classes, dtype="bfloat16",
-                              stem="space_to_depth")
+                              stem="space_to_depth", norm=norm)
 
         def forward(self, scope, x):
             x = (x.astype(jnp.bfloat16) - 127.0) * (1.0 / 64.0)
@@ -362,48 +383,60 @@ def bench_resnet50() -> None:
                 "y": np.int32(pool_labels[j])}
 
     chunk_steps, n_chunks = 5, 4
-    est = Estimator.from_keras(TrainNet(),
-                               loss="sparse_categorical_crossentropy",
-                               optimizer="sgd", learning_rate=0.1)
     feed0 = as_feed((pool[:global_batch].copy(),
                      pool_labels[:global_batch].astype(np.int32)),
                     global_batch, shuffle=False)
     b0 = next(feed0.epoch(mesh, 0))
-    est._ensure_initialized(b0["x"])
+    steps, repeats = 20, 3
 
-    # model FLOPs/image from XLA's cost analysis of the compiled forward
-    def fwd(v, x):
-        out, _ = est.model.apply(v, x, training=False)
-        return out
+    def build_and_measure(norm: str):
+        """Estimator + XLA-cost-analysis FLOPs + resident repeats for one
+        ResNet-50 norm config."""
+        est = Estimator.from_keras(TrainNet(norm),
+                                   loss="sparse_categorical_crossentropy",
+                                   optimizer="sgd", learning_rate=0.1)
+        est._ensure_initialized(b0["x"])
 
-    flops_per_image = 0.0
-    try:
-        var_struct = {"params": est._ts["params"], "state": est._ts["state"]}
-        cost = (jax.jit(fwd).lower(var_struct, b0["x"]).compile()
-                .cost_analysis())
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_image = float(cost.get("flops", 0.0)) / global_batch
-    except Exception:
-        pass
-    if flops_per_image <= 0:  # canonical RN50 estimate, res-scaled
-        flops_per_image = 4.089e9 * (size / 224.0) ** 2
-    train_flops_per_image = 3.0 * flops_per_image  # bwd ~= 2x fwd
+        def fwd(v, x):
+            out, _ = est.model.apply(v, x, training=False)
+            return out
+
+        fpi = 0.0
+        try:
+            var_struct = {"params": est._ts["params"],
+                          "state": est._ts["state"]}
+            cost = (jax.jit(fwd).lower(var_struct, b0["x"]).compile()
+                    .cost_analysis())
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            fpi = float(cost.get("flops", 0.0)) / global_batch
+        except Exception:
+            pass
+        if fpi <= 0:  # canonical RN50 estimate, res-scaled
+            fpi = 4.089e9 * (size / 224.0) ** 2
+
+        est._ts, warm = est._multi_step(est._ts, b0, steps)
+        _ = float(warm[-1])
+
+        def run_resident():
+            t0 = time.perf_counter()
+            est._ts, losses = est._multi_step(est._ts, b0, steps)
+            _ = float(losses[-1])
+            return time.perf_counter() - t0
+
+        dt, dt_median, spread = _timed_repeats(run_resident, repeats)
+        return est, fpi, dt, dt_median, spread
 
     # -- phase 1: device-resident batch (pure-compute MFU, the headline;
-    # stable against the device tunnel's transfer-throughput swings) ------
-    steps, repeats = 20, 3
-    est._ts, warm = est._multi_step(est._ts, b0, steps)
-    _ = float(warm[-1])
-
-    def run_resident():
-        t0 = time.perf_counter()
-        est._ts, losses = est._multi_step(est._ts, b0, steps)
-        _ = float(losses[-1])
-        return time.perf_counter() - t0
-
-    dt, dt_median, rel_spread = _timed_repeats(run_resident, repeats)
+    # stable against the device tunnel's transfer-throughput swings).
+    # The BENCHMARKED config is the normalizer-free recipe; classic
+    # exact-BN is measured back-to-back in the same window for detail.
+    est, flops_per_image, dt, dt_median, rel_spread = \
+        build_and_measure("nf")
+    train_flops_per_image = 3.0 * flops_per_image  # bwd ~= 2x fwd
     ips = steps * global_batch / dt
+    _, bn_fpi, bn_dt, _, bn_spread = build_and_measure("batch")
+    bn_ips = steps * global_batch / bn_dt
 
     # -- phase 2: end-to-end streaming via infeed chunks ------------------
     # Tunnel-exposed: retry JUST this phase until it lands within 15% of
@@ -411,19 +444,16 @@ def bench_resnet50() -> None:
     # task 8 — four rounds never caught RN50 streaming in a clean window).
     n_workers, prefetch = 8, 4  # shared by BOTH feeds: the phase-3 warmup
     #                             drain must match the measured pipeline
-    stream_ips, stream_dt_per_step, stream_attempts = 0.0, 0.0, 0
-    for _ in range(3):
-        stream_attempts += 1
+    def run_stream():
         feed2 = StreamingDataFeed(
             num_samples=(n_chunks + 2) * chunk_steps * global_batch,
             load_sample=load_sample, batch_size=global_batch, shuffle=False,
             num_workers=n_workers, prefetch_batches=prefetch)
         s_dt, n = _stream_train(est, feed2, mesh, chunk_steps, n_chunks)
-        cur = n * global_batch / s_dt
-        if cur > stream_ips:
-            stream_ips, stream_dt_per_step = cur, s_dt / n
-        if stream_ips >= 0.85 * ips:
-            break
+        return n * global_batch / s_dt, s_dt / n
+
+    stream_ips, stream_dt_per_step, stream_attempts = _retry_streaming(
+        run_stream, ips)
 
     # -- phase 3: host-side feed-only throughput --------------------------
     # The streaming number above depends on the shared device tunnel's
@@ -452,20 +482,26 @@ def bench_resnet50() -> None:
     if peak > 0:
         mfu = ips * train_flops_per_image / (peak * n_chips)
         stream_mfu = stream_ips * train_flops_per_image / (peak * n_chips)
+        bn_mfu = bn_ips * 3.0 * bn_fpi / (peak * n_chips)
         vs_baseline = mfu / 0.40
     else:
-        mfu = stream_mfu = vs_baseline = 0.0
+        mfu = stream_mfu = bn_mfu = vs_baseline = 0.0
     ratio = stream_ips / ips
     _emit("resnet50_train_images_per_sec_per_chip", ips / n_chips,
           "images/s/chip", vs_baseline,
-          {"mfu": round(mfu, 4), "streaming_mfu": round(stream_mfu, 4),
+          {"variant": "nf (normalizer-free: Scaled WS convs + folded "
+                      "SkipInit; ResNet(norm='nf'))",
+           "mfu": round(mfu, 4), "streaming_mfu": round(stream_mfu, 4),
+           "bn_mfu": round(bn_mfu, 4),
+           "bn_images_per_sec_per_chip": round(bn_ips / n_chips, 1),
+           "bn_step_ms": round(1000 * bn_dt / steps, 2),
+           "bn_rel_spread": round(bn_spread, 4),
            "streaming_images_per_sec_per_chip":
                round(stream_ips / n_chips, 1),
            "streaming_over_resident": round(ratio, 4),
            "streaming_attempts": stream_attempts,
            **({"streaming_contended": True} if ratio < 0.85 else {}),
            "repeats": repeats,
-           "step_ms_best": round(1000 * dt / steps, 2),
            "step_ms_median": round(1000 * dt_median / steps, 2),
            "rel_spread": round(rel_spread, 4),
            "host_feed_images_per_sec": round(host_feed_ips, 1),

@@ -9,8 +9,9 @@ env so two variants can run back-to-back in one tunnel window:
   RN50_STEPS=20      steps per timed scan
   RN50_REPEATS=5     timed repeats (prints each; best is the signal)
   RN50_VARIANT=...   free-form tag echoed in the output line
-  RN50_STEM=space_to_depth|conv7
-  RN50_NORM=bn|ghost:N|none  (model variants, where supported)
+  RN50_STEM=space_to_depth|conv
+  RN50_NORM=bn|nf    bn (default) = classic exact-BN ResNet-50;
+                     nf = normalizer-free (ScaledWSConv + SkipInit)
 
 Usage: python dev/rn50_step.py
 """

@@ -323,3 +323,49 @@ def test_text_classifier_pretrained_embeddings_frozen(tmp_path):
     out_orig = m.predict(ids[:4])
     np.testing.assert_allclose(np.asarray(out), np.asarray(out_orig),
                                atol=1e-5)
+
+
+def test_nf_resnet_forward_and_identity_at_init(rng):
+    """Normalizer-free ResNet (norm='nf'): Scaled WS convs, no BN.
+    SkipInit (folded into the last conv's weight scale) makes every
+    residual branch exactly zero at init, so each non-transition block
+    is the identity."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models import ResNet
+    from analytics_zoo_tpu.models.image import _NFResBlock
+
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    m = ResNet(depth=50, class_num=10, norm="nf")
+    variables = m.init(jax.random.PRNGKey(0), x)
+    out, _ = m.apply(variables, x, training=True)
+    assert out.shape == (2, 10)
+    assert bool(jnp.isfinite(out).all())
+
+    # a non-transition nf block is the identity at init
+    blk = _NFResBlock(4, stride=1, bottleneck=True, beta=1.0, alpha=0.2)
+    h = jnp.asarray(rng.normal(size=(2, 8, 8, 16)).astype(np.float32))
+    bv = blk.init(jax.random.PRNGKey(1), h)
+    y, _ = blk.apply(bv, h)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(h), atol=1e-6)
+
+
+def test_nf_resnet_skip_gain_learns(rng):
+    """The folded SkipInit must still receive gradient at init (the
+    weight-space adjoint equals the activation-space sum dy*h), and a
+    small NF ResNet must train."""
+    from analytics_zoo_tpu.models import ResNet
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    xs = rng.normal(0, 1, (128, 16, 16, 3)).astype(np.float32)
+    ys = (rng.integers(0, 2, 128)).astype(np.int32)
+    xs[ys == 1, :, :, 0] += 2.0
+    m = ResNet(depth=18, class_num=2, norm="nf", width=8)
+    est = Estimator.from_keras(m, loss="sparse_categorical_crossentropy",
+                               optimizer="adam", learning_rate=3e-3)
+    hist = est.fit((xs, ys), epochs=4, batch_size=32, verbose=False)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.8, hist["loss"]
+    # skip_gain params exist and moved off zero
+    leaves = jax.tree_util.tree_leaves_with_path(est._ts["params"])
+    gains = [v for p, v in leaves if "skip_gain" in jax.tree_util.keystr(p)]
+    assert gains and any(float(abs(g)) > 1e-5 for g in gains)

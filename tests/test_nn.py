@@ -392,3 +392,108 @@ def test_batchnorm_badly_centered_channels():
     # normalized output: ~zero mean, ~unit std per channel
     np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-2)
     np.testing.assert_allclose(out.std(0), 1.0, atol=0.05)
+
+
+def test_scaled_ws_conv2d_standardization():
+    """ScaledWSConv2D uses g*(W-mean)/(std*sqrt(fan_in)) — the conv of a
+    constant input must be ~zero (kernel mean removed), and the layer
+    must differ from plain Conv2D with the same raw weights."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 3)).astype(np.float32))
+    ws = nn.ScaledWSConv2D(4, 3, use_bias=False)
+    v = ws.init(jax.random.PRNGKey(0), x)
+    ones = jnp.ones_like(x)
+    y0, _ = ws.apply(v, ones)
+    # interior positions see the full kernel -> exactly the (zero) mean
+    assert float(jnp.abs(y0[:, 1:-1, 1:-1, :]).max()) < 1e-5
+    plain = nn.Conv2D(4, 3, use_bias=False)
+    yp, _ = plain.apply(v, x)  # same raw kernel param
+    yw, _ = ws.apply(v, x)
+    assert float(jnp.abs(yw - yp).max()) > 1e-4
+
+
+def test_scaled_ws_conv2d_skip_init_gradient_flows():
+    """skip_init folds a zero-init scalar into the kernel: output is 0
+    at init, but dL/d(skip_gain) is nonzero (weight-space adjoint), so
+    the branch can learn away from zero."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 6, 6, 3)).astype(np.float32))
+    conv = nn.ScaledWSConv2D(4, 3, use_bias=False, skip_init=True,
+                             branch_scale=0.5)
+    v = conv.init(jax.random.PRNGKey(0), x)
+    y, _ = conv.apply(v, x)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-7)
+
+    def loss(params):
+        out, _ = conv.apply({**v, "params": params}, x)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    g = jax.grad(loss)(v["params"])
+    sg = g["skip_gain"]
+    assert float(jnp.abs(sg)) > 0.0
+    # kernel grad is zero at skip_gain=0 (branch output independent of W)
+    assert float(jnp.abs(g["kernel"]).max()) == 0.0
+
+
+def test_fused_bn_matches_reference_forward_and_grad():
+    """ops/fused_bn.bn_train (custom VJP used by BatchNormalization in
+    channel-last training) must match the textbook f32 batch norm in
+    value AND in x/gamma/beta gradients, including the mean/var output
+    cotangent terms."""
+    from analytics_zoo_tpu.ops import fused_bn
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray((3.0 + 1.5 * rng.normal(size=(4, 5, 5, 6)))
+                    .astype(np.float32))
+    g = jnp.asarray(rng.normal(size=(6,)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(6,)).astype(np.float32))
+
+    def ref(x, g, b, eps=1e-3):
+        m = x.mean((0, 1, 2))
+        v = x.var((0, 1, 2))
+        return (x - m) * jax.lax.rsqrt(v + eps) * g + b, m, v
+
+    y, m, v = fused_bn.bn_train(x, g, b, 1e-3)
+    yr, mr, vr = ref(x, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(vr), rtol=1e-5)
+
+    def mk_loss(fn):
+        def loss(x, g, b):
+            y, m, v = fn(x, g, b, 1e-3) if fn is fused_bn.bn_train \
+                else fn(x, g, b)
+            return (jnp.sum(jnp.sin(y)) + jnp.sum(m * 1.3)
+                    + jnp.sum(v * 0.7))
+        return loss
+
+    gf = jax.grad(mk_loss(fused_bn.bn_train), argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(mk_loss(ref), argnums=(0, 1, 2))(x, g, b)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=3e-4, rtol=1e-4)
+
+
+def test_batchnorm_training_uses_fused_path_consistently():
+    """BatchNormalization training through the fused VJP must produce
+    the same outputs/statistics as before (channel-last) and still work
+    on the inline path (channel-first)."""
+    rng = np.random.default_rng(3)
+    x = (2.0 + rng.normal(size=(16, 4, 4, 8))).astype(np.float32)
+    bn = nn.BatchNormalization(momentum=0.9)
+    v = bn.init(jax.random.PRNGKey(0), jnp.asarray(x), training=True)
+    out, state = bn.apply(v, jnp.asarray(x), training=True)
+    out = np.asarray(out)
+    np.testing.assert_allclose(out.mean((0, 1, 2)), 0.0, atol=1e-3)
+    np.testing.assert_allclose(out.std((0, 1, 2)), 1.0, atol=0.05)
+    # running stats updated toward batch stats
+    st = state
+    np.testing.assert_allclose(np.asarray(st["mean"]),
+                               0.1 * x.mean((0, 1, 2)), rtol=1e-3)
+    # channel-first falls back to the inline path and still normalizes
+    bn1 = nn.BatchNormalization(axis=1)
+    xc = np.transpose(x, (0, 3, 1, 2))
+    v1 = bn1.init(jax.random.PRNGKey(0), jnp.asarray(xc), training=True)
+    o1, _ = bn1.apply(v1, jnp.asarray(xc), training=True)
+    np.testing.assert_allclose(np.asarray(o1).mean((0, 2, 3)), 0.0,
+                               atol=1e-3)
