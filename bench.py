@@ -210,13 +210,16 @@ def bench_bert() -> None:
 
     d_model, n_heads, n_layers, vocab, seq = 768, 12, 12, 30522, 512
     # The canonical BERT-base SQuAD recipe trains at global batch 32; on
-    # v5e that's 4 micro-batches of 8 per optimizer step (grad_accum) —
-    # micro-batch 8 is the measured best-fusing size, and accumulation
-    # amortizes the optimizer's full f32 param/moment sweep (profiled at
-    # ~26% of a step) over 4 micro-batches.  Both knobs overridable for
-    # sweeps: BENCH_BERT_BATCH (per-micro), BENCH_BERT_ACCUM.
-    batch = int(os.environ.get("BENCH_BERT_BATCH", "8"))
-    accum = int(os.environ.get("BENCH_BERT_ACCUM", "4"))
+    # v5e that's 8 micro-batches of 4 per optimizer step (grad_accum).
+    # Round-5 sweep under rematerialized attention (same window, ms/step
+    # at global 32): micro 8 = 99.9 (58.9% MFU), micro 4 = 93.3 (63.0%),
+    # micro 2 = 98.9 (59.4%), micro 16 = 128.9 (45.6%); micro 4 without
+    # remat = 95.4 (61.7%).  Accumulation amortizes the optimizer's full
+    # f32 param/moment sweep (profiled at ~26% of an unaccumulated step)
+    # over 8 micro-batches.  Both knobs overridable for sweeps:
+    # BENCH_BERT_BATCH (per-micro), BENCH_BERT_ACCUM.
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "4"))
+    accum = int(os.environ.get("BENCH_BERT_ACCUM", "8"))
 
     class Encoder(nn.Module):
         def forward(self, scope, ids):
@@ -225,8 +228,13 @@ def bench_bert() -> None:
                               (1, ids.shape[1], d_model))
             x = (x + pos).astype(jnp.bfloat16)
             for i in range(n_layers):
-                x = scope.child(nn.TransformerLayer(n_heads), x,
-                                name=f"block{i}")
+                # remat_attention: recompute logits/softmax in backward
+                # instead of saving T x T maps — measured 110 -> 99.9 ms
+                # at micro 8 (and the Pallas flash kernel measured a net
+                # LOSS here, 124.6 ms: the dense-with-remat path wins at
+                # seq 512).
+                x = scope.child(nn.TransformerLayer(
+                    n_heads, remat_attention=True), x, name=f"block{i}")
             # head matmul in bf16 (f32 accumulation inside Dense); the
             # loss upcasts logits to f32 for the softmax.  Measured
             # negative result (2026-07-31, v5e): the chunked fused-CE head

@@ -1,0 +1,91 @@
+"""Fast BERT resident-step timer for perf iteration (dev tool).
+
+Mirrors bench.py's bert resident phase (same encoder, grad_accum, MFU
+math imported from bench) without the streaming phase.  Defaults match
+the benchmarked config (micro 4 x accum 8, remat attention).  Knobs:
+
+  BERT_BATCH=4      per-chip micro batch
+  BERT_ACCUM=8      grad accumulation (global batch = batch*accum)
+  BERT_STEPS=50     steps per timed scan
+  BERT_REPEATS=5    timed repeats
+  BERT_FLASH=0|1    flash-attention kernel in the training path
+  BERT_REMAT=1|0    rematerialized dense attention (the bench default;
+                    mutually exclusive with BERT_FLASH=1)
+  BERT_VARIANT=tag  echoed in the output line
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.data import as_feed
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    d_model, n_heads, n_layers, vocab, seq = 768, 12, 12, 30522, 512
+    batch = int(os.environ.get("BERT_BATCH", "4"))
+    accum = int(os.environ.get("BERT_ACCUM", "8"))
+    steps = int(os.environ.get("BERT_STEPS", "50"))
+    repeats = int(os.environ.get("BERT_REPEATS", "5"))
+    use_flash = os.environ.get("BERT_FLASH", "0") == "1"
+    remat = os.environ.get("BERT_REMAT", "1") == "1"
+    variant = os.environ.get("BERT_VARIANT", "base")
+
+    class Encoder(nn.Module):
+        def forward(self, scope, ids):
+            x = scope.child(nn.Embedding(vocab, d_model), ids, name="tok")
+            pos = scope.param("pos", nn.initializers.get("normal"),
+                              (1, ids.shape[1], d_model))
+            x = (x + pos).astype(jnp.bfloat16)
+            for i in range(n_layers):
+                x = scope.child(
+                    nn.TransformerLayer(n_heads, use_flash=use_flash,
+                                        remat_attention=remat),
+                    x, name=f"block{i}")
+            return scope.child(nn.Dense(vocab), x, name="head")
+
+    mesh = init_orca_context("local")
+    n_chips = jax.device_count()
+    global_batch = batch * accum * n_chips
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (global_batch, seq))
+    labels = rng.integers(0, vocab, (global_batch, seq))
+    est = Estimator.from_keras(Encoder(),
+                               loss="sparse_categorical_crossentropy",
+                               optimizer="adamw", learning_rate=1e-4,
+                               grad_accum=accum)
+    b0 = next(as_feed((ids, labels), global_batch, shuffle=False)
+              .epoch(mesh, 0))
+    est._ensure_initialized(b0["x"])
+    est._ts, warm = est._multi_step(est._ts, b0, steps)
+    _ = float(warm[-1])
+
+    dts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        est._ts, losses = est._multi_step(est._ts, b0, steps)
+        _ = float(losses[-1])
+        dts.append((time.perf_counter() - t0) / steps)
+    best = min(dts)
+    tps = global_batch * seq / best
+    from bench import flops_per_token, peak_flops_per_chip
+    fpt = flops_per_token(d_model, n_layers, seq, vocab)
+    mfu = tps * fpt / (peak_flops_per_chip() * n_chips)
+    print(f"[{variant}] step_ms={[round(1e3 * d, 2) for d in dts]} "
+          f"best={1e3 * best:.2f}ms tok/s={tps:.0f} mfu={mfu:.4f}")
+
+
+if __name__ == "__main__":
+    main()

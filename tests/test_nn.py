@@ -497,3 +497,35 @@ def test_batchnorm_training_uses_fused_path_consistently():
     o1, _ = bn1.apply(v1, jnp.asarray(xc), training=True)
     np.testing.assert_allclose(np.asarray(o1).mean((0, 2, 3)), 0.0,
                                atol=1e-3)
+
+
+def test_transformer_remat_attention_exact():
+    """remat_attention=True must be numerically identical to the plain
+    path in forward AND gradients — it only changes what is saved for
+    the backward pass."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 12, 32)).astype(np.float32))
+    base = nn.TransformerLayer(4)
+    remat = nn.TransformerLayer(4, remat_attention=True)
+    v = base.init(KEY, x)
+    yb, _ = base.apply(v, x)
+    yr, _ = remat.apply(v, x)  # same params: same layer structure
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(yr), atol=1e-6)
+
+    def loss(params, layer):
+        out, _ = layer.apply({"params": params}, x)
+        return jnp.sum(jnp.sin(out))
+
+    gb = jax.grad(loss)(v["params"], base)
+    gr = jax.grad(loss)(v["params"], remat)
+    for (pb, lb), (pr, lr) in zip(
+            jax.tree_util.tree_leaves_with_path(gb),
+            jax.tree_util.tree_leaves_with_path(gr)):
+        assert pb == pr
+        np.testing.assert_allclose(np.asarray(lb), np.asarray(lr),
+                                   atol=1e-5)
+
+
+def test_mha_remat_conflicts_with_kernel_paths():
+    with pytest.raises(ValueError, match="remat"):
+        nn.MultiHeadAttention(num_heads=2, use_flash=True, remat=True)
