@@ -181,6 +181,11 @@ class Conv2D(Module):
         self.groups = groups
         self.dtype = dtype
 
+    # plain Conv2D participates in calibrated int8 activation
+    # quantization (serving); kernel-transforming subclasses
+    # (ScaledWSConv2D) opt out — their weight math needs the float kernel
+    _act_quant = True
+
     def _kernel(self, scope: Scope, shape: Tuple[int, ...]) -> jax.Array:
         """Weight fetch hook — subclasses may transform (e.g. weight
         standardization) before the conv consumes it."""
@@ -191,6 +196,25 @@ class Conv2D(Module):
         in_ch = x.shape[-1]
         w = self._kernel(scope, (kh, kw, in_ch // self.groups,
                                  self.filters))
+        q = scope.quant
+        if q is not None and q.mode == "collect" and self._act_quant:
+            q.observe(scope.path, x)
+        if isinstance(w, dict):  # int8 serving: {marker, q, scale} kernel
+            from . import quant as _quant
+            if q is not None and q.mode == "apply":
+                y = _quant.conv_quantized(
+                    q, scope.path, x, w["q"], w["scale"], self.strides,
+                    self.padding, self.dilation, self.groups,
+                    q.compute_dtype)
+                if y is not None:
+                    y = y.astype(x.dtype)
+                    if self.use_bias:
+                        b = scope.param("bias", initializers.get("zeros"),
+                                        (self.filters,))
+                        y = y + b.astype(y.dtype)
+                    return self.activation(y)
+            # weight-only fallback: dequant fuses into the conv
+            w = w["q"].astype(x.dtype) * w["scale"].astype(x.dtype)
         xc = _cast_for_compute(x, self.dtype)
         wc = _cast_for_compute(w, self.dtype).astype(xc.dtype)
         pad_free = (self.padding in ("SAME", "VALID")
@@ -257,6 +281,8 @@ class ScaledWSConv2D(Conv2D):
     ``shortcut + s*h`` form cost ~1.3 ms/step of map->scalar VPU
     reduces per big block; the folded form removes them entirely.
     """
+
+    _act_quant = False  # weight standardization needs the float kernel
 
     def __init__(self, *args, skip_init: bool = False,
                  branch_scale: float = 1.0, **kwargs):

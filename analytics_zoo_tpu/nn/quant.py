@@ -4,15 +4,18 @@ Reference (SURVEY.md §2.8): the OpenVINO path ran INT8 inference with
 activation scales derived from an offline CALIBRATION pass
 (``OpenVinoInferenceSupportive`` model-optimizer INT8 calibration).  The
 TPU-native analog: a quant context threaded through the module ``Scope``
-— a calibration pass records each Dense input's absolute maximum (static,
-per-tensor), then serving-time Dense layers quantize activations with
-those frozen scales and run the matmul as int8 x int8 -> int32 on the MXU,
-rescaling per output channel.
+— a calibration pass records each participating layer's input absolute
+maximum (static, per-tensor), then serving-time layers quantize
+activations with those frozen scales and run the contraction as
+int8 x int8 -> int32 on the MXU, rescaling per output channel.
 
-Only Dense participates in activation quantization (the transformer/
-recommender serving hot path); conv layers keep weight-only int8 (their
-dequant fuses into the conv).  ``InferenceModel.load(dtype="int8",
-calibrate=batch)`` wires it up.
+Participating layers: ``nn.Dense`` (the transformer/recommender serving
+hot path) and plain ``nn.Conv2D`` (the CNN serving path — the reference's
+OpenVINO INT8 calibrated whole CNNs; int8 x int8 -> int32
+``conv_general_dilated`` is exact on the v5e MXU, probe-verified).
+``ScaledWSConv2D`` and other kernel-transforming subclasses stay
+weight-only (their weight math needs the float kernel).
+``InferenceModel.load(dtype="int8", calibrate=batch)`` wires it up.
 """
 
 from __future__ import annotations
@@ -61,6 +64,28 @@ class QuantApply:
         if a is None or a <= 0.0:
             return None
         return a / 127.0
+
+
+def conv_quantized(ctx, path, x, wq, w_scale, strides, padding, dilation,
+                   groups, compute_dtype):
+    """int8 convolution with a static activation scale: q(x) conv wq ->
+    int32 on the MXU, then one fused rescale by (s_in * s_w[channel]).
+    Symmetric quantization, so "SAME" zero-padding is exact (q(0) = 0)."""
+    import jax
+
+    s_in = ctx.scale_for(path)
+    if s_in is None:
+        return None  # layer never seen in calibration: float fallback
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_in)),
+                  -127, 127).astype(jnp.int8)
+    y32 = jax.lax.conv_general_dilated(
+        xq, wq, window_strides=strides, padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    scale = (jnp.asarray(w_scale, jnp.float32).reshape(-1) * s_in)
+    return (y32.astype(jnp.float32) * scale).astype(compute_dtype)
 
 
 def dense_quantized(ctx, path, x, wq, w_scale, compute_dtype):

@@ -9,10 +9,11 @@ Client semantics are preserved: ``InputQueue.enqueue`` → uuid,
 ``OutputQueue.query(uuid)`` → ndarray.
 """
 
-from .inference_model import InferenceModel
+from .inference_model import InferenceModel, enable_aot_cache
 from .server import ClusterServing
 from .client import InputQueue, OutputQueue
 from .http_frontend import HTTPFrontend
 
-__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue",
+__all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
+           "InputQueue", "OutputQueue",
            "HTTPFrontend"]

@@ -68,20 +68,21 @@ def _quantize_tree(variables: Any, compute_dtype: Any) -> Any:
 
 
 def _dequantize_tree(variables: Any, compute_dtype: Any,
-                     dense_paths: Optional[frozenset] = None) -> Any:
+                     calibrated_paths: Optional[frozenset] = None) -> Any:
     """Inverse of ``_quantize_tree`` — runs INSIDE the jitted forward, so
     XLA fuses the int8→float multiply into the consumer.  With
-    ``dense_paths`` (calibrated-activation mode: the scope paths the
-    Calibrator saw, i.e. exactly the nn.Dense layers), those layers'
-    kernels stay int8 dicts for Dense's own int8 GEMM path; every other
-    quantized leaf — conv kernels, but also 2-D kernels of layers that
-    CANNOT consume the dict form (LSTM/GRU input kernels, Highway) —
-    dequantizes as usual."""
+    ``calibrated_paths`` (calibrated-activation mode: the scope paths the
+    Calibrator saw — nn.Dense and plain nn.Conv2D layers), those layers'
+    kernels stay int8 dicts for their own int8 GEMM/conv paths; every
+    other quantized leaf — kernels of layers that CANNOT consume the dict
+    form (LSTM/GRU input kernels, Highway, ScaledWSConv2D) — dequantizes
+    as usual."""
     def walk(node, path=()):
         if isinstance(node, dict):
             if _Q_MARKER in node:
-                if (dense_paths is not None and path and path[-1] == "kernel"
-                        and "/".join(path[:-1]) in dense_paths):
+                if (calibrated_paths is not None and path
+                        and path[-1] == "kernel"
+                        and "/".join(path[:-1]) in calibrated_paths):
                     return node
                 return (node["q"].astype(compute_dtype)
                         * node["scale"].astype(compute_dtype))
@@ -93,6 +94,17 @@ def _dequantize_tree(variables: Any, compute_dtype: Any,
     return {k: walk(v) if k != "params" else
             {kk: walk(vv, (kk,)) for kk, vv in v.items()}
             for k, v in variables.items()}
+
+
+def enable_aot_cache(path: str) -> None:
+    """Point JAX's persistent compilation cache at ``path`` so serving
+    executables compile once per machine, not once per process — with
+    ``save_executables`` (skips tracing/lowering) this is the full
+    OpenVINO-IR analog: a restart reuses the compiled artifact.  Safe to
+    call more than once; applies process-wide."""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 class InferenceModel:
@@ -120,10 +132,11 @@ class InferenceModel:
           parameter traffic; on-chip dequant to bf16 fuses into the
           consuming matmul).
         ``calibrate``: with ``dtype="int8"``, a representative input batch
-        — one float forward records every Dense input's absolute maximum;
-        serving then quantizes those ACTIVATIONS with the frozen static
-        scales and runs Dense matmuls as int8 x int8 -> int32 on the MXU
-        (conv layers stay weight-only).  The reference's OpenVINO INT8
+        — one float forward records every Dense and plain-Conv2D input's
+        absolute maximum; serving then quantizes those ACTIVATIONS with
+        the frozen static scales and runs the matmuls/convolutions as
+        int8 x int8 -> int32 on the MXU (kernel-transforming convs, e.g.
+        ScaledWSConv2D, stay weight-only).  The reference's OpenVINO INT8
         calibration analog (``OpenVinoInferenceSupportive`` calibrate +
         doLoadOpenVINOInt8); without ``calibrate`` the int8 path is
         weight-only, as before."""
@@ -187,30 +200,139 @@ class InferenceModel:
             with self._lock:
                 fn = self._compiled.get(key)
                 if fn is None:
-                    model = self._model
-                    quantized = self._quantized
-                    cdtype = getattr(self, "_compute_dtype", None)
-                    qctx = getattr(self, "_quant_ctx", None)
-
-                    dense_paths = (frozenset(qctx.amax)
-                                   if qctx is not None else None)
-
-                    def fwd(variables, x):
-                        if quantized:
-                            variables = _dequantize_tree(
-                                variables, cdtype, dense_paths=dense_paths)
-                        out, _ = model.apply(variables, x, training=False,
-                                             quant=qctx)
-                        return out
-
                     # AOT compile for this exact shape (reference: OpenVINO
                     # compiled per input shape too)
-                    fn = (jax.jit(fwd)
+                    fn = (jax.jit(self._fwd_for_export())
                           .lower(self._variables,
                                  jax.ShapeDtypeStruct(shape, dtype))
                           .compile())
                     self._compiled[key] = fn
         return fn
+
+    # -- AOT executable serialization (reference: OpenVINO IR — a compiled
+    # artifact loadable without re-running the model optimizer) -------------
+
+    def _config_fingerprint(self) -> str:
+        """Identity of the serving configuration an exported executable
+        is only valid for: precision mode + calibration scales + the
+        variable tree's structure/dtypes/shapes (a bf16-cast or
+        quantized load produces a different tree than f32)."""
+        import hashlib
+        qctx = getattr(self, "_quant_ctx", None)
+        leaves = [
+            (jax.tree_util.keystr(p), str(getattr(l, "dtype", type(l))),
+             str(getattr(l, "shape", ())))
+            for p, l in jax.tree_util.tree_leaves_with_path(
+                self._variables)]
+        parts = [str(getattr(self, "_compute_dtype", None)),
+                 str(self._quantized),
+                 repr(sorted(qctx.amax.items())) if qctx else "none",
+                 repr(sorted(leaves))]
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+    def _computation_hash(self, shape, dtype) -> str:
+        """Hash of the serving computation's JAXPR for one input bucket —
+        catches MODEL CODE changes (activation swap, stride edit, new
+        layer) that leave the variable tree identical.  Costs one trace
+        (no lowering, no XLA compile): the cheap third of a cold start."""
+        import hashlib
+
+        var_struct = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(getattr(l, "shape", ()),
+                                           getattr(l, "dtype", np.float32)),
+            self._variables)
+        jaxpr = jax.make_jaxpr(self._fwd_for_export())(
+            var_struct, jax.ShapeDtypeStruct(shape, np.dtype(dtype)))
+        return hashlib.sha256(str(jaxpr).encode()).hexdigest()[:16]
+
+    def save_executables(self, path: str) -> int:
+        """Serialize the per-shape serving computations (jax.export
+        StableHLO artifacts) so a later process can skip tracing/lowering
+        — pair with ``enable_aot_cache`` to also skip the XLA compile.
+        Saves one blob per (shape, dtype) bucket compiled so far, plus a
+        manifest; returns the number saved.  Typically called next to
+        ``ZooModel.save_model`` output."""
+        import json
+        import os
+
+        from jax import export as jexport
+
+        os.makedirs(path, exist_ok=True)
+        manifest = {"fingerprint": self._config_fingerprint(), "keys": []}
+        n = 0
+        for (shape, dtype_str) in list(self._compiled):
+            fwd = self._fwd_for_export()
+            exp = jexport.export(jax.jit(fwd))(
+                self._variables,
+                jax.ShapeDtypeStruct(shape, np.dtype(dtype_str)))
+            fname = f"exec_{n}.bin"
+            with open(os.path.join(path, fname), "wb") as f:
+                f.write(exp.serialize())
+            manifest["keys"].append({"shape": list(shape),
+                                     "dtype": dtype_str, "file": fname,
+                                     "jaxpr": self._computation_hash(
+                                         shape, dtype_str)})
+            n += 1
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        return n
+
+    def load_executables(self, path: str, verify: bool = True) -> int:
+        """Load serialized serving computations saved by
+        ``save_executables``: deserialized artifacts skip lowering and —
+        when the persistent compilation cache (``enable_aot_cache``) is
+        warm — the XLA compile.  An artifact is ignored (falls back to a
+        fresh compile) when the serving configuration differs from save
+        time, or, with ``verify=True`` (default), when the CURRENT model
+        code's traced computation no longer matches the saved one —
+        catching silent staleness after a model edit at the cost of one
+        trace per bucket (no lowering/compile).  ``verify=False`` is the
+        trust-the-artifact fast path."""
+        import json
+        import os
+
+        from jax import export as jexport
+
+        mf = os.path.join(path, "manifest.json")
+        if not os.path.exists(mf):
+            return 0
+        with open(mf) as f:
+            manifest = json.load(f)
+        if manifest.get("fingerprint") != self._config_fingerprint():
+            return 0
+        n = 0
+        for item in manifest["keys"]:
+            try:
+                key = (tuple(item["shape"]), item["dtype"])
+                if verify and item.get("jaxpr") != self._computation_hash(
+                        key[0], key[1]):
+                    continue  # model code changed: recompile this bucket
+                with open(os.path.join(path, item["file"]), "rb") as f:
+                    exp = jexport.deserialize(f.read())
+                with self._lock:
+                    self._compiled[key] = exp.call
+                n += 1
+            except Exception:  # topology/version mismatch: recompile
+                continue
+        return n
+
+    def _fwd_for_export(self):
+        """The serving forward as a pure fn of (variables, x) — the same
+        computation ``_fn_for`` AOT-compiles."""
+        model = self._model
+        quantized = self._quantized
+        cdtype = getattr(self, "_compute_dtype", None)
+        qctx = getattr(self, "_quant_ctx", None)
+        calibrated = frozenset(qctx.amax) if qctx is not None else None
+
+        def fwd(variables, x):
+            if quantized:
+                variables = _dequantize_tree(
+                    variables, cdtype, calibrated_paths=calibrated)
+            out, _ = model.apply(variables, x, training=False, quant=qctx)
+            return out
+
+        return fwd
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Batched forward; pads to the nearest bucket so compiles are

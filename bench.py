@@ -31,6 +31,9 @@ Configs (BASELINE.md table; select one with ``--config``, default all):
   ncf       NCF through the Friesian FeatureTable pipeline (string-id
             encode -> negative sampling -> train) — examples/sec/chip.
   autots    Chronos AutoTS search — trials/hour.
+  serving   ClusterServing TCP loopback: ResNet-18 classifier, offered-load
+            sweep (1/8/32 clients) x precision (fp32/bf16/calibrated int8)
+            — QPS + p50/p99 latency + cold-start + AOT-artifact reload.
 
 The reference published no numbers (BASELINE.md); the acceptance bar from
 BASELINE.json is >=40%% MFU for bert/resnet50 (``vs_baseline`` =
@@ -58,6 +61,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 # Public peak bf16 dense FLOP/s per chip, keyed by device_kind substring.
@@ -76,7 +80,8 @@ _PEAK_BF16 = [
 # records only the tail of stdout, so the records that carry the
 # acceptance-bar evidence must be the final lines (the round-4 artifact
 # lost the opening of its first-printed record to tail truncation).
-CONFIGS = ("lenet", "ncf", "autots", "scaling", "resnet50", "bert")
+CONFIGS = ("lenet", "ncf", "autots", "scaling", "serving",
+           "resnet50", "bert")
 
 
 def peak_flops_per_chip() -> float:
@@ -677,6 +682,188 @@ def bench_autots() -> None:
            "chips": n_chips, "device_kind": kind})
 
 
+# -- serving ------------------------------------------------------------------
+
+def bench_serving() -> None:
+    """Serving performance through the REAL ClusterServing path
+    (reference: the whole L9 Redis/Flink/OpenVINO stack existed for this
+    number — SURVEY §2.8): a conv-heavy classifier behind the TCP
+    loopback frontend; closed-loop offered-load sweep at 1/8/32
+    concurrent client connections for fp32 / bf16 / calibrated-int8,
+    p50/p99 round-trip latency + QPS, plus cold-start (first-request
+    trace+lower+XLA compile) and the AOT-artifact reload time
+    (save_executables + enable_aot_cache — the OpenVINO-IR analog)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.models import ResNet
+    from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
+                                           InputQueue, OutputQueue,
+                                           enable_aot_cache)
+
+    init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+    size, classes, server_batch = 224, 1000, 16
+
+    # persistent compilation cache ON for the whole child: the fresh
+    # compiles populate it, the AOT-reload measurement hits it
+    cache_dir = tempfile.mkdtemp(prefix="zoo_aot_cache_")
+    enable_aot_cache(cache_dir)
+
+    class ServeNet(nn.Module):
+        """uint8 NHWC -> on-device normalize -> ResNet-18 classifier
+        (conv-heavy: exercises the int8-conv serving path)."""
+
+        def __init__(self):
+            super().__init__()
+            self.net = ResNet(depth=18, class_num=classes)
+
+        def forward(self, scope, x):
+            x = (x.astype(jnp.float32) - 127.0) * (1.0 / 64.0)
+            return scope.child(self.net, x, name="resnet")
+
+    model = ServeNet()
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (server_batch, size, size, 3),
+                       dtype=np.uint8)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(img))
+    calib = img  # representative batch for int8 activation scales
+
+    def client_loop(results, errors, deadline, port):
+        # one RECORD per enqueue (reference API: the server batcher
+        # stacks records into [B, ...]); thread failures land in
+        # ``errors`` — the record carries them, so a broken precision
+        # mode cannot read as a clean benchmark
+        try:
+            inq = InputQueue(port=port)
+            outq = OutputQueue(input_queue=inq)
+            one = img[0]
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                uid = inq.enqueue("bench", t=one)
+                if outq.query(uid, timeout=60.0) is None:
+                    raise RuntimeError("serving request timed out")
+                results.append(time.perf_counter() - t0)
+            inq.close()
+        except Exception as e:  # noqa: BLE001 - recorded in the artifact
+            errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    modes = {}
+    best_qps = 0.0
+    for mode in ("float32", "bfloat16", "int8"):
+        im = InferenceModel(batch_buckets=(1, 4, 16))
+        if mode == "int8":
+            im.load(model, variables, dtype="int8", calibrate=calib)
+        elif mode == "bfloat16":
+            im.load(model, variables, dtype=jnp.bfloat16)
+        else:
+            im.load(model, variables)
+        # cold start: first predict = trace + lower + XLA compile + run
+        t0 = time.perf_counter()
+        im.predict(img)
+        cold_s = time.perf_counter() - t0
+        # pre-warm the smaller batch buckets so the load sweep measures
+        # serving, not their first-compile
+        im.predict(img[:1])
+        im.predict(img[:3])
+        # warm direct-call latency (no TCP, bucket batch): the device+
+        # dispatch floor under this environment's shared tunnel
+        t0 = time.perf_counter()
+        for _ in range(10):
+            im.predict(img)
+        warm_batch_ms = (time.perf_counter() - t0) / 10 * 1000
+
+        sweep = {}
+        with ClusterServing(im, batch_size=server_batch,
+                            batch_timeout_ms=5) as srv:
+            for conc in (1, 8, 32):
+                lat, errs = [], []
+                deadline = time.perf_counter() + 4.0
+                threads = [threading.Thread(
+                    target=client_loop,
+                    args=(lat, errs, deadline, srv.port))
+                    for _ in range(conc)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                point = {}
+                if lat:
+                    lat_ms = np.sort(np.asarray(lat)) * 1000
+                    point = {
+                        "qps": round(len(lat) / wall, 1),
+                        "p50_ms": round(float(lat_ms[len(lat_ms) // 2]),
+                                        2),
+                        "p99_ms": round(
+                            float(lat_ms[min(len(lat_ms) - 1,
+                                             int(len(lat_ms) * 0.99))]),
+                            2),
+                    }
+                    best_qps = max(best_qps, len(lat) / wall)
+                if errs:
+                    point["client_errors"] = len(errs)
+                    point["first_error"] = errs[0]
+                sweep[str(conc)] = point
+            srv_stats = srv.stats()
+        # AOT-artifact reload: serialized executables + warm compile
+        # cache -> a fresh InferenceModel's first predict without the
+        # cold-start compile
+        aot_dir = tempfile.mkdtemp(prefix="zoo_aot_exec_")
+        n_saved = im.save_executables(aot_dir)
+
+        def reload_and_time():
+            im2 = InferenceModel(batch_buckets=(1, 4, 16))
+            if mode == "int8":
+                im2.load(model, variables, dtype="int8", calibrate=calib)
+            elif mode == "bfloat16":
+                im2.load(model, variables, dtype=jnp.bfloat16)
+            else:
+                im2.load(model, variables)
+            n = im2.load_executables(aot_dir)
+            t0 = time.perf_counter()
+            im2.predict(img)
+            return n, time.perf_counter() - t0
+
+        # FIRST reload still XLA-compiles the deserialized module (its
+        # HLO key differs from the jit path's) and populates the
+        # persistent cache; every LATER restart with the same artifacts
+        # is the warm number — that pair is the OpenVINO-IR story.
+        n_loaded, aot_first = reload_and_time()
+        _, aot_warm = reload_and_time()
+        modes[mode] = {
+            "cold_start_s": round(cold_s, 2),
+            "aot_reload_first_s": round(aot_first, 2),
+            "aot_reload_warm_s": round(aot_warm, 2),
+            "aot_artifacts_saved": n_saved,
+            "aot_artifacts_loaded": n_loaded,
+            "warm_batch16_ms": round(warm_batch_ms, 2),
+            "load_sweep": sweep,
+            "server_mean_batch": round(srv_stats["mean_batch_size"], 2),
+        }
+
+    # a clean benchmark requires EVERY (mode, concurrency) point to have
+    # data and no client errors; anything else marks the record
+    clean = all("qps" in pt and "client_errors" not in pt
+                for m in modes.values() for pt in m["load_sweep"].values()
+                ) and all(len(m["load_sweep"]) == 3 for m in modes.values())
+    _emit("serving_qps_best", best_qps, "requests/s (closed-loop max)",
+          1.0 if (best_qps > 0 and clean) else 0.0,
+          {"model": "uint8 224x224 -> ResNet-18 classifier "
+                    "(ClusterServing TCP loopback, server batch 16)",
+           "modes": modes, "concurrency_sweep": [1, 8, 32],
+           "chips": n_chips, "device_kind": kind,
+           "note": "latency includes this environment's shared device "
+                   "tunnel dispatch; p50 at conc=1 is the per-request "
+                   "floor, QPS at conc=32 the batched throughput"})
+
+
 # -- scaling ------------------------------------------------------------------
 
 def bench_scaling() -> None:
@@ -746,7 +933,7 @@ def bench_scaling() -> None:
 
 _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "lenet": bench_lenet, "ncf": bench_ncf, "autots": bench_autots,
-            "scaling": bench_scaling}
+            "scaling": bench_scaling, "serving": bench_serving}
 
 
 # Per-config child budget: (timeout seconds per attempt, max attempts).
@@ -754,7 +941,8 @@ _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
 # workloads corrupt both measurements), so the matrix's worst case must stay
 # bounded — the cheap configs get a shorter leash than the two MFU configs.
 _BUDGET = {"bert": (1800, 3), "resnet50": (1800, 3), "lenet": (900, 2),
-           "ncf": (900, 2), "autots": (1800, 2), "scaling": (1200, 2)}
+           "ncf": (900, 2), "autots": (1800, 2), "scaling": (1200, 2),
+           "serving": (1800, 2)}
 
 
 def _run_child(config: str, attempts: int | None = None) -> int:

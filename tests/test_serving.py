@@ -461,3 +461,129 @@ def test_calibrator_rejects_traced_forward():
 
     with pytest.raises(RuntimeError, match="UNJITTED"):
         jax.jit(f)(jnp.ones((2, 2)))
+
+
+def test_inference_model_int8_calibrated_conv():
+    """Calibrated int8 for CNNs (reference: OpenVINO INT8 calibrated
+    whole CNNs): plain Conv2D inputs get static activation scales and
+    run as int8 x int8 -> int32 convs; accuracy stays bounded vs f32 and
+    the conv kernels really stay int8 through the serving path."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([
+        nn.Conv2D(32, 3, activation="relu"),
+        nn.Conv2D(64, 3, strides=2, activation="relu"),
+        nn.GlobalAveragePooling2D(),
+        nn.Dense(10)])
+    rng = np.random.default_rng(7)
+    calib = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    x = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(calib))
+
+    ref = InferenceModel().load(model, variables)
+    q = InferenceModel().load(model, variables, dtype="int8",
+                              calibrate=calib)
+    # both convs AND the dense observed during calibration
+    assert q._quant_ctx is not None and len(q._quant_ctx.amax) == 3
+    out_ref = np.asarray(ref.predict(x), np.float32)
+    out_q = np.asarray(q.predict(x), np.float32)
+    denom = np.maximum(np.abs(out_ref), 1.0)
+    assert np.max(np.abs(out_q - out_ref) / denom) < 0.2
+    agree = np.mean(out_q.argmax(1) == out_ref.argmax(1))
+    assert agree >= 0.75, agree
+
+
+def test_ws_conv_stays_weight_only_under_calibration():
+    """ScaledWSConv2D must NOT take the activation-quantized path (its
+    weight standardization needs the float kernel): calibration must
+    skip it and serving must still produce finite, close-to-f32 output."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    # kernel 3*3*24*64 = 13,824 elements: ABOVE _Q_MIN_SIZE, so it
+    # really is stored int8 and the WS conv must dequantize the dict
+    # (a sub-threshold kernel would stay float and test nothing)
+    model = nn.Sequential([
+        nn.ScaledWSConv2D(64, 3, activation="relu"),
+        nn.GlobalAveragePooling2D(),
+        nn.Dense(8)])
+    rng = np.random.default_rng(8)
+    calib = rng.normal(size=(8, 12, 12, 24)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(calib))
+    q = InferenceModel().load(model, variables, dtype="int8",
+                              calibrate=calib)
+    # only the Dense observed — the WS conv opted out
+    assert len(q._quant_ctx.amax) == 1
+    ref = InferenceModel().load(model, variables)
+    out_q = np.asarray(q.predict(calib), np.float32)
+    out_ref = np.asarray(ref.predict(calib), np.float32)
+    assert np.all(np.isfinite(out_q))
+    denom = np.maximum(np.abs(out_ref), 1.0)
+    assert np.max(np.abs(out_q - out_ref) / denom) < 0.2
+
+
+def test_save_load_executables_roundtrip(tmp_path):
+    """Serialized AOT artifacts (reference: OpenVINO IR) round-trip: a
+    fresh InferenceModel loads them, skips tracing, and predicts the
+    same values; a config mismatch (different precision) ignores them."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(32, activation="relu"), nn.Dense(4)])
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    src = InferenceModel().load(model, variables)
+    want = np.asarray(src.predict(x))
+    n = src.save_executables(str(tmp_path / "aot"))
+    assert n == 1  # one (shape, dtype) bucket compiled
+
+    dst = InferenceModel().load(model, variables)
+    assert dst.load_executables(str(tmp_path / "aot")) == 1
+    got = np.asarray(dst.predict(x))  # served via the deserialized artifact
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # precision mismatch -> artifacts ignored, fresh compile still works
+    other = InferenceModel().load(model, variables, dtype=jnp.bfloat16)
+    assert other.load_executables(str(tmp_path / "aot")) == 0
+    assert np.asarray(other.predict(x)).shape == want.shape
+
+
+def test_load_executables_rejects_stale_model_code(tmp_path):
+    """A model-code edit that leaves the variable tree identical must
+    NOT silently serve the stale artifact: the traced-computation hash
+    (manifest "jaxpr") catches it; verify=False trusts the artifact."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    relu_net = nn.Sequential([nn.Dense(16, activation="relu"),
+                              nn.Dense(4)])
+    gelu_net = nn.Sequential([nn.Dense(16, activation="gelu"),
+                              nn.Dense(4)])  # same param tree, new math
+    variables = relu_net.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    src = InferenceModel().load(relu_net, variables)
+    src.predict(x)
+    assert src.save_executables(str(tmp_path / "aot")) == 1
+
+    stale = InferenceModel().load(gelu_net, variables)
+    assert stale.load_executables(str(tmp_path / "aot")) == 0
+    # and the unverified fast path loads it (caller's responsibility)
+    assert stale.load_executables(str(tmp_path / "aot"),
+                                  verify=False) == 1
