@@ -14,6 +14,13 @@ Run:  python examples/ncf_friesian.py --epochs 3
 
 from __future__ import annotations
 
+# allow `python examples/<script>.py` straight from a checkout (the
+# CI harness sets PYTHONPATH; a user following the README should not
+# need to): put the repo root ahead of the script's own directory
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -14,8 +14,14 @@ Run:  python examples/resnet_imageset.py --epochs 2 --depth 18
 
 from __future__ import annotations
 
-import argparse
+# allow `python examples/<script>.py` straight from a checkout (the
+# CI harness sets PYTHONPATH; a user following the README should not
+# need to): put the repo root ahead of the script's own directory
 import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
 import tempfile
 
 import numpy as np

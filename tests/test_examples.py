@@ -75,3 +75,10 @@ def test_torch_import_example():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "validation:" in proc.stdout
     assert "max |diff|" in proc.stdout
+
+
+def test_int8_aot_serving_example():
+    proc = _run("int8_aot_serving.py")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "top-1 agreement" in proc.stdout
+    assert "outputs identical" in proc.stdout
