@@ -777,6 +777,44 @@ def bench_serving() -> None:
         for _ in range(10):
             im.predict(img)
         warm_batch_ms = (time.perf_counter() - t0) / 10 * 1000
+        # device-RESIDENT batch-16 latency: K batches scanned in ONE
+        # executable (input pre-staged), so tunnel dispatch/transfer is
+        # amortized away — the precision comparison (fp32/bf16/int8)
+        # that per-call latency buries under tunnel weather
+        fwd = im._fwd_for_export()
+        K = 20
+
+        def resident_ms(batch_img):
+            xs = jnp.asarray(np.broadcast_to(
+                batch_img, (K,) + batch_img.shape))
+
+            @jax.jit
+            def run_resident(v, xs):
+                def body(c, x):
+                    out = fwd(v, x)
+                    return c + out.astype(jnp.float32).sum(), None
+                s, _ = jax.lax.scan(body, jnp.float32(0.0), xs)
+                return s
+
+            _ = float(run_resident(im._variables, xs))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                _ = float(run_resident(im._variables, xs))
+            return (time.perf_counter() - t0) / (3 * K) * 1000
+
+        device_batch_ms = resident_ms(img)
+        # batch 1: the single-request low-latency case.  (Measured:
+        # batch-1 ~= batch-16 latency — this model is launch-bound at
+        # these sizes, so int8's win is modest; its 4x-smaller weights
+        # matter more for HBM capacity than for this latency.)
+        # Hoisting note: the scan body is NOT reduced to bf16 for int8 —
+        # every calibrated layer's kernel stays an int8 dict consumed
+        # in-loop by the int8 GEMM/conv (x-dependent activation
+        # quantization prevents hoisting); only NON-calibrated quantized
+        # leaves would dequant loop-invariantly, and this model has none
+        # (all convs + the head are calibrated, BN params are below the
+        # quantization size floor).
+        device_one_ms = resident_ms(img[:1])
 
         sweep = {}
         with ClusterServing(im, batch_size=server_batch,
@@ -844,6 +882,8 @@ def bench_serving() -> None:
             "aot_artifacts_saved": n_saved,
             "aot_artifacts_loaded": n_loaded,
             "warm_batch16_ms": round(warm_batch_ms, 2),
+            "device_batch16_ms": round(device_batch_ms, 3),
+            "device_batch1_ms": round(device_one_ms, 3),
             "load_sweep": sweep,
             "server_mean_batch": round(srv_stats["mean_batch_size"], 2),
         }
