@@ -13,7 +13,7 @@ optional fused-kernel hook — ``analytics_zoo_tpu.ops.flash_attention``
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +21,17 @@ import jax.numpy as jnp
 from . import initializers
 from .layers import Dense, Dropout, LayerNormalization
 from .module import Module, Scope
+
+
+# use_flash="auto" switches to the Pallas flash kernel at this kv length.
+# Measured crossover (BERT-base, v5e, fixed global batch, ms/step best):
+#   seq  512: dense+remat  99.9 vs flash 124.6  -> dense wins
+#   seq 1024: dense+remat  67.1 vs flash  82.0  -> dense wins
+#   seq 2048: dense+remat 314.5 vs flash 201.6  -> flash 1.56x
+#   seq 4096: dense+remat 764.6 vs flash 377.0  -> flash 2.03x
+# Below ~2k the kernel's blocked-backward overhead exceeds the saved
+# T x T traffic; above it, not materializing the maps dominates.
+FLASH_AUTO_MIN_SEQ = 2048
 
 
 def causal_mask(tq: int, tk: Optional[int] = None) -> jax.Array:
@@ -50,11 +61,16 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 class MultiHeadAttention(Module):
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
-                 dropout: float = 0.0, use_flash: bool = False,
+                 dropout: float = 0.0,
+                 use_flash: Union[bool, str] = False,
                  use_ring: bool = False, causal: bool = False,
                  remat: bool = False, dtype: Optional[Any] = None,
                  name: Optional[str] = None):
         super().__init__(name)
+        if use_flash not in (True, False, "auto"):
+            raise ValueError(
+                f"use_flash must be True, False, or 'auto'; got "
+                f"{use_flash!r}")
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.dropout = dropout
@@ -70,14 +86,19 @@ class MultiHeadAttention(Module):
         # (124.6 ms); XLA was materializing per-layer probability maps
         # for the backward.  Exact: same math, recomputed.
         self.remat = remat
-        if remat and (use_flash or use_ring):
+        # use_flash: True | False | "auto" — "auto" picks the flash
+        # kernel when the kv length reaches FLASH_AUTO_MIN_SEQ (the
+        # measured crossover) and there is no explicit mask; below it,
+        # the dense path (+ remat if set) wins.  Same math either way.
+        if remat and (use_flash is True or use_ring):
             # the flash/ring kernels already avoid materializing the
             # T x T maps — remat would silently be a no-op there; make
-            # the conflicting config an error, not a wrong measurement
+            # the conflicting config an error, not a wrong measurement.
+            # ("auto" composes: remat applies when auto picks dense.)
             raise ValueError(
                 "remat=True applies to the dense attention path only; "
                 "use_flash/use_ring kernels already rematerialize — "
-                "pick one")
+                "pick one (use_flash='auto' composes with remat)")
         self.dtype = dtype
 
     def forward(self, scope: Scope, x: jax.Array,
@@ -100,10 +121,14 @@ class MultiHeadAttention(Module):
         k = proj("wk", kv)
         v = proj("wv", kv)
 
+        use_flash = self.use_flash
+        if use_flash == "auto":
+            use_flash = (mask is None
+                         and kv.shape[1] >= FLASH_AUTO_MIN_SEQ)
         if self.use_ring and mask is None:
             from analytics_zoo_tpu.parallel import ring_self_attention
             ctx = ring_self_attention(q, k, v, causal=self.causal)
-        elif self.use_flash and mask is None:
+        elif use_flash and mask is None:
             from analytics_zoo_tpu.ops import flash_attention
             ctx = flash_attention(q, k, v, causal=self.causal)
         else:
@@ -128,7 +153,8 @@ class TransformerLayer(Module):
 
     def __init__(self, num_heads: int, hidden_mult: int = 4,
                  dropout: float = 0.0, pre_ln: bool = False,
-                 use_flash: bool = False, use_ring: bool = False,
+                 use_flash: Union[bool, str] = False,
+                 use_ring: bool = False,
                  causal: bool = False, remat_attention: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)

@@ -11,6 +11,8 @@ the benchmarked config (micro 4 x accum 8, remat attention).  Knobs:
   BERT_FLASH=0|1    flash-attention kernel in the training path
   BERT_REMAT=1|0    rematerialized dense attention (the bench default;
                     mutually exclusive with BERT_FLASH=1)
+  BERT_SEQ=512      sequence length (long-context: 2048/4096 with
+                    BERT_FLASH=1 — the flash kernel's regime)
   BERT_VARIANT=tag  echoed in the output line
 """
 
@@ -33,7 +35,8 @@ def main() -> None:
     from analytics_zoo_tpu.data import as_feed
     from analytics_zoo_tpu.orca.learn import Estimator
 
-    d_model, n_heads, n_layers, vocab, seq = 768, 12, 12, 30522, 512
+    d_model, n_heads, n_layers, vocab = 768, 12, 12, 30522
+    seq = int(os.environ.get("BERT_SEQ", "512"))
     batch = int(os.environ.get("BERT_BATCH", "4"))
     accum = int(os.environ.get("BERT_ACCUM", "8"))
     steps = int(os.environ.get("BERT_STEPS", "50"))

@@ -529,3 +529,33 @@ def test_transformer_remat_attention_exact():
 def test_mha_remat_conflicts_with_kernel_paths():
     with pytest.raises(ValueError, match="remat"):
         nn.MultiHeadAttention(num_heads=2, use_flash=True, remat=True)
+
+
+def test_mha_use_flash_auto_crossover(monkeypatch):
+    """use_flash='auto' runs the dense path (+remat) below the measured
+    crossover and the flash kernel at/above it — same math either way."""
+    from analytics_zoo_tpu.nn import attention as attn_mod
+
+    monkeypatch.setattr(attn_mod, "FLASH_AUTO_MIN_SEQ", 8)
+    rng = np.random.default_rng(5)
+    short = jnp.asarray(rng.normal(size=(2, 4, 16)).astype(np.float32))
+    longx = jnp.asarray(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    auto = nn.MultiHeadAttention(num_heads=2, use_flash="auto", remat=True)
+    dense = nn.MultiHeadAttention(num_heads=2)
+    flash = nn.MultiHeadAttention(num_heads=2, use_flash=True)
+    v = auto.init(KEY, short)
+    # below crossover: identical to the dense path
+    ys, _ = auto.apply(v, short)
+    yd, _ = dense.apply(v, short)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd), atol=1e-6)
+    # at/above crossover: identical to the flash path (and close to dense)
+    yl, _ = auto.apply(v, longx)
+    yf, _ = flash.apply(v, longx)
+    np.testing.assert_allclose(np.asarray(yl), np.asarray(yf), atol=1e-6)
+    yld, _ = dense.apply(v, longx)
+    np.testing.assert_allclose(np.asarray(yl), np.asarray(yld), atol=1e-4)
+
+
+def test_mha_use_flash_validates_values():
+    with pytest.raises(ValueError, match="use_flash"):
+        nn.MultiHeadAttention(num_heads=2, use_flash="Auto")
