@@ -199,6 +199,7 @@ class Conv2D(Module):
         q = scope.quant
         if q is not None and q.mode == "collect" and self._act_quant:
             q.observe(scope.path, x)
+        y = None
         if isinstance(w, dict):  # int8 serving: {marker, q, scale} kernel
             from . import quant as _quant
             if q is not None and q.mode == "apply":
@@ -208,13 +209,20 @@ class Conv2D(Module):
                     q.compute_dtype)
                 if y is not None:
                     y = y.astype(x.dtype)
-                    if self.use_bias:
-                        b = scope.param("bias", initializers.get("zeros"),
-                                        (self.filters,))
-                        y = y + b.astype(y.dtype)
-                    return self.activation(y)
-            # weight-only fallback: dequant fuses into the conv
-            w = w["q"].astype(x.dtype) * w["scale"].astype(x.dtype)
+            if y is None:
+                # weight-only fallback: dequant fuses into the conv
+                w = w["q"].astype(x.dtype) * w["scale"].astype(x.dtype)
+        if y is None:
+            y = self._float_conv(x, w)
+        if self.use_bias:
+            b = scope.param("bias", initializers.get("zeros"),
+                            (self.filters,))
+            y = y + b.astype(y.dtype)
+        return self.activation(y)
+
+    def _float_conv(self, x: jax.Array, w: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        in_ch = x.shape[-1]
         xc = _cast_for_compute(x, self.dtype)
         wc = _cast_for_compute(w, self.dtype).astype(xc.dtype)
         pad_free = (self.padding in ("SAME", "VALID")
@@ -239,11 +247,7 @@ class Conv2D(Module):
                 rhs_dilation=self.dilation,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 feature_group_count=self.groups)
-        y = y.astype(x.dtype) if x.dtype != y.dtype else y
-        if self.use_bias:
-            b = scope.param("bias", initializers.get("zeros"), (self.filters,))
-            y = y + b.astype(y.dtype)
-        return self.activation(y)
+        return y.astype(x.dtype) if x.dtype != y.dtype else y
 
 
 def scaled_ws_kernel(w: jax.Array, gain: jax.Array) -> jax.Array:

@@ -66,38 +66,47 @@ class QuantApply:
         return a / 127.0
 
 
+def _quantize_activation(ctx, path, x):
+    """Shared preamble of the int8 paths: the frozen static scale (or
+    None for the float fallback) and the symmetrically quantized input
+    (zero-point 0, so "SAME" zero-padding stays exact)."""
+    s_in = ctx.scale_for(path)
+    if s_in is None:
+        return None, None  # layer never seen in calibration
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_in)),
+                  -127, 127).astype(jnp.int8)
+    return s_in, xq
+
+
+def _rescale(y32, w_scale, s_in, compute_dtype):
+    """Shared postamble: one fused (s_in * s_w[channel]) rescale."""
+    scale = jnp.asarray(w_scale, jnp.float32).reshape(-1) * s_in
+    return (y32.astype(jnp.float32) * scale).astype(compute_dtype)
+
+
 def conv_quantized(ctx, path, x, wq, w_scale, strides, padding, dilation,
                    groups, compute_dtype):
     """int8 convolution with a static activation scale: q(x) conv wq ->
-    int32 on the MXU, then one fused rescale by (s_in * s_w[channel]).
-    Symmetric quantization, so "SAME" zero-padding is exact (q(0) = 0)."""
-    import jax
-
-    s_in = ctx.scale_for(path)
+    int32 on the MXU, then one fused per-output-channel rescale."""
+    s_in, xq = _quantize_activation(ctx, path, x)
     if s_in is None:
-        return None  # layer never seen in calibration: float fallback
-    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_in)),
-                  -127, 127).astype(jnp.int8)
+        return None
     y32 = jax.lax.conv_general_dilated(
         xq, wq, window_strides=strides, padding=padding,
         rhs_dilation=dilation,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
         preferred_element_type=jnp.int32)
-    scale = (jnp.asarray(w_scale, jnp.float32).reshape(-1) * s_in)
-    return (y32.astype(jnp.float32) * scale).astype(compute_dtype)
+    return _rescale(y32, w_scale, s_in, compute_dtype)
 
 
 def dense_quantized(ctx, path, x, wq, w_scale, compute_dtype):
     """int8 GEMM with static activation scale: q(x) @ wq -> int32, then
-    one fused rescale by (s_in * s_w[channel])."""
-    s_in = ctx.scale_for(path)
+    one fused per-output-channel rescale."""
+    s_in, xq = _quantize_activation(ctx, path, x)
     if s_in is None:
-        return None  # layer never seen in calibration: float fallback
-    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_in)),
-                  -127, 127).astype(jnp.int8)
+        return None
     y32 = jax.lax.dot_general(
         xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
-    scale = (jnp.asarray(w_scale, jnp.float32).reshape(-1) * s_in)
-    return (y32.astype(jnp.float32) * scale).astype(compute_dtype)
+    return _rescale(y32, w_scale, s_in, compute_dtype)

@@ -753,16 +753,19 @@ def bench_serving() -> None:
         except Exception as e:  # noqa: BLE001 - recorded in the artifact
             errors.append(f"{type(e).__name__}: {e}"[:200])
 
+    def load_mode(mode):
+        im = InferenceModel(batch_buckets=(1, 4, 16))
+        if mode == "int8":
+            return im.load(model, variables, dtype="int8",
+                           calibrate=calib)
+        if mode == "bfloat16":
+            return im.load(model, variables, dtype=jnp.bfloat16)
+        return im.load(model, variables)
+
     modes = {}
     best_qps = 0.0
     for mode in ("float32", "bfloat16", "int8"):
-        im = InferenceModel(batch_buckets=(1, 4, 16))
-        if mode == "int8":
-            im.load(model, variables, dtype="int8", calibrate=calib)
-        elif mode == "bfloat16":
-            im.load(model, variables, dtype=jnp.bfloat16)
-        else:
-            im.load(model, variables)
+        im = load_mode(mode)
         # cold start: first predict = trace + lower + XLA compile + run
         t0 = time.perf_counter()
         im.predict(img)
@@ -857,13 +860,7 @@ def bench_serving() -> None:
         n_saved = im.save_executables(aot_dir)
 
         def reload_and_time():
-            im2 = InferenceModel(batch_buckets=(1, 4, 16))
-            if mode == "int8":
-                im2.load(model, variables, dtype="int8", calibrate=calib)
-            elif mode == "bfloat16":
-                im2.load(model, variables, dtype=jnp.bfloat16)
-            else:
-                im2.load(model, variables)
+            im2 = load_mode(mode)
             n = im2.load_executables(aot_dir)
             t0 = time.perf_counter()
             im2.predict(img)
