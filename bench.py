@@ -982,6 +982,47 @@ _BUDGET = {"bert": (1800, 3), "resnet50": (1800, 3), "lenet": (900, 2),
            "serving": (1800, 2)}
 
 
+def _device_preflight(max_wait_s: int = 1500,
+                      probe_timeout_s: int = 120) -> bool:
+    """The matrix needs a live device + compile service; against a dead
+    tunnel every config would burn its full timeout*attempts budget
+    producing only skip records (observed: a trivial jit hanging >10
+    minutes during a tunnel outage).  Probe a trivial jit in a child
+    and, on failure, retry every minute up to ``max_wait_s`` — a
+    transient outage then DELAYS the matrix instead of voiding it.
+    Returns False when the budget exhausts (the matrix still runs; its
+    skip records become the evidence of the outage)."""
+    deadline = time.monotonic() + max_wait_s
+    code = ("import jax, jax.numpy as jnp; "
+            "print(float(jax.jit(lambda x: (x @ x).sum())"
+            "(jnp.ones((128, 128)))))")
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=probe_timeout_s)
+            if proc.returncode == 0:
+                if attempt > 1:
+                    sys.stderr.write(
+                        f"bench preflight: device recovered on probe "
+                        f"{attempt}\n")
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() >= deadline:
+            sys.stderr.write(
+                f"bench preflight: device unreachable after {attempt} "
+                f"probes over {max_wait_s}s; proceeding — expect skip "
+                f"records\n")
+            return False
+        sys.stderr.write(
+            f"bench preflight: probe {attempt} failed (device/compile "
+            f"service unresponsive); retrying in 60s\n")
+        time.sleep(60)
+
+
 def _run_child(config: str, attempts: int | None = None) -> int:
     """Run one config's measurement in a fresh child process; retry
     transient failures (compile-service flakes and the like) with backoff.
@@ -1096,7 +1137,10 @@ def main() -> None:
         return
     if args.config != "all":
         sys.exit(_run_child(args.config, args.attempts))
-    # Full matrix.  Exit 0 only if EVERY config produced a real number —
+    # Full matrix: wait out a transient device outage first (a dead
+    # tunnel would turn the whole matrix into skip records).
+    _device_preflight()
+    # Exit 0 only if EVERY config produced a real number —
     # a CI consumer checking just the return code must not miss a
     # persistently failing config; the per-config skip records on stdout
     # carry the reason for any non-zero exit.

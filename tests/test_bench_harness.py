@@ -96,3 +96,26 @@ def test_mfu_configs_print_last():
     """The driver records only the stdout TAIL: the acceptance-bar
     records (resnet50, bert) must be the final lines of the matrix."""
     assert bench.CONFIGS[-2:] == ("resnet50", "bert")
+
+
+def test_device_preflight_returns_on_success(monkeypatch):
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(1)
+        return types.SimpleNamespace(returncode=0, stdout="1.0\n",
+                                     stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._device_preflight(max_wait_s=5) is True
+    assert len(calls) == 1
+
+
+def test_device_preflight_gives_up_after_budget(monkeypatch):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: types.SimpleNamespace(
+                            returncode=1, stdout="", stderr="boom"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    t = iter(range(0, 10_000, 100))  # monotonic advances 100s per call
+    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
+    assert bench._device_preflight(max_wait_s=250) is False
