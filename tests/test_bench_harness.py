@@ -111,11 +111,37 @@ def test_device_preflight_returns_on_success(monkeypatch):
     assert len(calls) == 1
 
 
-def test_device_preflight_gives_up_after_budget(monkeypatch):
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **k: types.SimpleNamespace(
-                            returncode=1, stdout="", stderr="boom"))
+def test_device_preflight_bails_fast_on_deterministic_failure(
+        monkeypatch):
+    """Instant nonzero exits (broken env) must not burn the wait
+    budget — only hangs (TimeoutExpired) are worth waiting out."""
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(1)
+        return types.SimpleNamespace(returncode=1, stdout="",
+                                     stderr="boom")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._device_preflight(max_wait_s=10_000) is False
+    assert len(calls) == 3
+
+
+def test_device_preflight_waits_out_hangs(monkeypatch):
+    def fake_run(*a, **k):
+        raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     t = iter(range(0, 10_000, 100))  # monotonic advances 100s per call
     monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
     assert bench._device_preflight(max_wait_s=250) is False
+
+
+def test_device_preflight_skips_on_forced_cpu(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("must not probe")))
+    assert bench._device_preflight() is True
