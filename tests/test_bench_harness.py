@@ -114,7 +114,7 @@ def test_device_preflight_returns_on_success(monkeypatch):
 def test_device_preflight_bails_fast_on_deterministic_failure(
         monkeypatch):
     """Instant nonzero exits (broken env) must not burn the wait
-    budget — only hangs (TimeoutExpired) are worth waiting out."""
+    budget — only hangs/slow errors are worth waiting out."""
     calls = []
 
     def fake_run(*a, **k):
@@ -124,8 +124,30 @@ def test_device_preflight_bails_fast_on_deterministic_failure(
 
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # probes return "instantly": monotonic advances 1s per call
+    t = iter(range(0, 100_000))
+    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
     assert bench._device_preflight(max_wait_s=10_000) is False
     assert len(calls) == 3
+
+
+def test_device_preflight_waits_out_slow_errors(monkeypatch):
+    """A nonzero exit that took ~probe-timeout (RPC deadline surfacing
+    as an error) is outage weather, not deterministic breakage: the
+    preflight keeps waiting instead of bailing after 3."""
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(1)
+        return types.SimpleNamespace(returncode=1, stdout="",
+                                     stderr="DEADLINE_EXCEEDED")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    t = iter(range(0, 100_000, 100))  # each probe "takes" 100s
+    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
+    assert bench._device_preflight(max_wait_s=1300) is False
+    assert len(calls) >= 4  # past the 3-failure point: no bail-out
 
 
 def test_device_preflight_waits_out_hangs(monkeypatch):
@@ -145,3 +167,44 @@ def test_device_preflight_skips_on_forced_cpu(monkeypatch):
                         lambda *a, **k: (_ for _ in ()).throw(
                             AssertionError("must not probe")))
     assert bench._device_preflight() is True
+
+
+def test_degraded_mode_short_leashes_device_configs(monkeypatch):
+    """After a failed preflight, device configs get one short attempt
+    (fast skip records); the CPU-sim scaling config keeps its budget."""
+    seen = {}
+
+    def fake_run(cmd, **k):
+        seen[cmd[cmd.index("--config") + 1]] = k["timeout"]
+        return types.SimpleNamespace(returncode=1, stdout="", stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._run_child("bert", degraded=True) == 1
+    assert seen["bert"] == 240
+    assert bench._run_child("scaling", degraded=True) == 1
+    assert seen["scaling"] == bench._BUDGET["scaling"][0]
+
+
+def test_degraded_mode_honors_explicit_attempts(monkeypatch):
+    seen = []
+
+    def fake_run(cmd, **k):
+        seen.append(k["timeout"])
+        return types.SimpleNamespace(returncode=1, stdout="", stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._run_child("bert", attempts=3, degraded=True) == 1
+    assert len(seen) == 3  # explicit attempts win over the short leash
+    assert all(t == 240 for t in seen)
+
+
+def test_degraded_skip_record_is_marked(monkeypatch, capsys):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: types.SimpleNamespace(
+                            returncode=1, stdout="", stderr=""))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._run_child("bert", degraded=True) == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["detail"]["degraded"] is True
