@@ -4,11 +4,14 @@ from .config import MeshConfig, ZooConfig
 from .context import (OrcaContext, get_mesh, init_nncontext,
                       init_orca_context, make_mesh, stop_orca_context)
 from . import checkpoint
+from . import faults
 from .failover import Preempted, PreemptionGuard
+from .faults import FaultRegistry
 from .summary import SummaryWriter
 
 __all__ = [
     "MeshConfig", "ZooConfig", "OrcaContext", "get_mesh", "init_nncontext",
     "init_orca_context", "make_mesh", "stop_orca_context", "checkpoint",
-    "SummaryWriter", "Preempted", "PreemptionGuard",
+    "SummaryWriter", "Preempted", "PreemptionGuard", "faults",
+    "FaultRegistry",
 ]

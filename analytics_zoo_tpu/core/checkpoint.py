@@ -34,15 +34,50 @@ meta is visible.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
-from typing import Any, Optional
+import time
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
+from . import faults as faults_lib
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
 _META = "treedef.json"
 _DATA = "arrays.npz"
+
+
+def _write_with_retry(fn: Callable[[], None], what: str, retries: int,
+                      retry_delay: float) -> None:
+    """Run a checkpoint write step, retrying transient OSErrors with
+    exponential backoff.  A blip on the shared filesystem (the TPU norm
+    for checkpoint storage) must not kill a preemption-window save — the
+    window is long enough for a few bounded retries, not for losing the
+    whole checkpoint.  The ``checkpoint.write_fail`` injection point
+    (core/faults.py) fires inside the attempt, so tests can prove the
+    retry path end to end."""
+    attempts = max(1, retries)
+    for attempt in range(1, attempts + 1):
+        try:
+            # default_exc=OSError: a fault armed without an explicit exc
+            # (e.g. via ZooConfig.faults) must still take the SAME retry
+            # path a real filesystem blip would
+            faults_lib.get_registry().raise_if("checkpoint.write_fail",
+                                               default_exc=OSError)
+            fn()
+            return
+        except OSError as e:
+            if attempt >= attempts:
+                raise
+            delay = retry_delay * (2 ** (attempt - 1))
+            logger.warning(
+                "checkpoint write (%s) failed: %s — retry %d/%d in %.2fs",
+                what, e, attempt, attempts - 1, delay)
+            time.sleep(delay)
 
 
 def _to_host(leaf: Any) -> Any:
@@ -87,7 +122,8 @@ def _key_to_index(key: str) -> tuple:
 
 
 def save(path: str, tree: Any, step: Optional[int] = None,
-         extra: Optional[dict] = None) -> str:
+         extra: Optional[dict] = None, retries: int = 3,
+         retry_delay: float = 0.05) -> str:
     """Write ``tree`` under directory ``path`` (created if needed).
 
     Multi-host: every process must call this.  Each process writes ONLY the
@@ -95,6 +131,12 @@ def save(path: str, tree: Any, step: Optional[int] = None,
     cross-host leaf; process 0 additionally writes the treedef + shard
     index.  Single-host leaves keep the dense single-file layout.  Returns
     the directory.
+
+    ``retries``/``retry_delay``: transient OSErrors during the data/meta
+    writes are retried with exponential backoff before giving up (each
+    process retries its own files independently; the cross-host barriers
+    sit after the retried sections, so a process that needed three
+    attempts just arrives at the barrier late).
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     pidx, pcount = jax.process_index(), jax.process_count()
@@ -151,18 +193,19 @@ def save(path: str, tree: Any, step: Optional[int] = None,
     # files untouched and its meta still pointing at them.
     gen = _new_generation(pidx, pcount)
     if my_shards or pcount > 1:
-        fd, tmp_sh = tempfile.mkstemp(dir=path, suffix=f".p{pidx}.tmp")
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **my_shards)
-        os.replace(tmp_sh, os.path.join(path, _shards_name(gen, pidx)))
+        def _write_shards() -> None:
+            fd, tmp_sh = tempfile.mkstemp(dir=path, suffix=f".p{pidx}.tmp")
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **my_shards)
+            os.replace(tmp_sh, os.path.join(path, _shards_name(gen, pidx)))
+
+        _write_with_retry(_write_shards, f"shards p{pidx}", retries,
+                          retry_delay)
     if pcount > 1:
         from jax.experimental import multihost_utils
         # all shard files must be complete before meta becomes visible
         multihost_utils.sync_global_devices("zoo_ckpt_shards_written")
     if pidx == 0:
-        fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
-        with os.fdopen(fd, "wb") as f:  # np.savez appends .npz to bare paths
-            np.savez(f, **arrays)
         meta = {
             "treedef": _treedef_to_json(treedef),
             "scalars": scalars,
@@ -174,11 +217,22 @@ def save(path: str, tree: Any, step: Optional[int] = None,
             "raw_dtypes": raw_dtypes,
             "extra": extra or {},  # small json-able caller metadata
         }
-        fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, os.path.join(path, _data_name(gen)))
-        os.replace(tmp_meta, os.path.join(path, _META))  # the commit point
+
+        def _write_data_and_meta() -> None:
+            fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
+            with os.fdopen(fd, "wb") as f:  # savez appends .npz to bare paths
+                np.savez(f, **arrays)
+            fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, os.path.join(path, _data_name(gen)))
+            os.replace(tmp_meta, os.path.join(path, _META))  # commit point
+
+        # a failed attempt leaves only fresh-generation temp/data files —
+        # the previous checkpoint's files and meta are untouched, so
+        # retrying the whole step is safe at any point
+        _write_with_retry(_write_data_and_meta, "data+meta", retries,
+                          retry_delay)
     if pcount > 1:
         from jax.experimental import multihost_utils
         # don't let any process see the checkpoint before meta is visible

@@ -105,6 +105,11 @@ class ZooConfig:
     log_dir: str = "/tmp/analytics_zoo_tpu"
     log_level: str = "INFO"
 
+    # fault injection (core/faults.py): {point: enable-kwargs}, e.g.
+    # {"serving.queue_reject": {"times": 3, "seed": 7}} — armed on the
+    # global registry by init_orca_context.  Empty = everything disabled.
+    faults: Dict[str, Any] = field(default_factory=dict)
+
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod

@@ -165,6 +165,12 @@ def init_orca_context(cluster_mode: str = "local",
                 "yarn/k8s/standalone modes map to 'multihost' here (resource "
                 "management is the TPU platform's job, not the framework's)")
 
+        if cfg.faults:
+            from .faults import get_registry
+            get_registry().configure(cfg.faults)
+            logger.warning("fault injection armed from config: %s",
+                           sorted(cfg.faults))
+
         _ZooContextMeta._mesh = make_mesh(cfg.mesh)
         _ZooContextMeta._config = cfg
         logger.info("initialized context: %d device(s), mesh %s",

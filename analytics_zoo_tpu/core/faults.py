@@ -1,0 +1,226 @@
+"""Deterministic fault injection for resilience tests.
+
+MLPerf-scale TPU pods treat transient host/network faults as routine, and
+the TensorFlow system paper makes the point directly: fault tolerance must
+be a first-class subsystem with *injectable* faults, not an emergent
+property.  This module is the injection side of that contract — a seedable
+registry of named injection points that production code calls at its
+failure-prone seams.  Disabled (the default) a hit is a dict lookup and a
+counter bump; tests (or a ZooConfig) arm individual points with a bounded
+fire count, a seeded probability, a delay, or an exception.
+
+Registered points (new subsystems add theirs via ``register_point``):
+
+- ``serving.conn_drop``      server closes a client connection mid-request
+- ``serving.model_latency``  extra latency before a serving batch runs
+- ``serving.queue_reject``   serving queue push rejected ("queue full")
+- ``checkpoint.write_fail``  transient checkpoint write failure (OSError)
+- ``feed.stall``             data feed stalls before yielding a batch
+
+Usage in a test::
+
+    from analytics_zoo_tpu.core import faults
+    with faults.get_registry().armed("serving.queue_reject", times=2):
+        ...  # first two queue pushes are rejected, then normal service
+
+Usage at an injection point (production code)::
+
+    faults.get_registry().raise_if("checkpoint.write_fail")   # raising
+    if faults.get_registry().fire("serving.queue_reject"):    # control flow
+        ok = False
+
+Determinism: probabilistic faults draw from a ``random.Random(seed)`` owned
+by the spec, so two runs with the same seed fire on exactly the same hits —
+never from global random state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import random
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Type
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: The framework's known injection points.  ``enable()`` rejects names not
+#: in this set so a typo in a test arms nothing silently.
+KNOWN_POINTS = {
+    "serving.conn_drop",
+    "serving.model_latency",
+    "serving.queue_reject",
+    "checkpoint.write_fail",
+    "feed.stall",
+}
+
+
+def register_point(name: str) -> str:
+    """Add a new injection point name (for subsystems grown later).
+    Idempotent; returns the name so it can be used as a module constant."""
+    KNOWN_POINTS.add(name)
+    return name
+
+
+class _Spec:
+    """Armed state of one injection point."""
+
+    __slots__ = ("times", "prob", "exc", "message", "delay", "rng")
+
+    def __init__(self, times: Optional[int], prob: float,
+                 exc: Optional[Type[BaseException]], message: Optional[str],
+                 delay: float, seed: int):
+        if times is not None and times < 1:
+            raise ValueError(f"times must be >= 1 or None, got {times}")
+        if not 0.0 < prob <= 1.0:
+            raise ValueError(f"prob must be in (0, 1], got {prob}")
+        self.times = times          # remaining fires; None = unlimited
+        self.prob = prob
+        self.exc = exc
+        self.message = message
+        self.delay = delay
+        self.rng = random.Random(seed)
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed faults + per-point hit/fire counters.
+
+    One process-global instance (``get_registry()``) serves the default
+    wiring; components accept an explicit registry for isolation."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._specs: Dict[str, _Spec] = {}
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+
+    # -- arming ---------------------------------------------------------------
+
+    def enable(self, name: str, *, times: Optional[int] = None,
+               prob: float = 1.0, exc: Optional[Type[BaseException]] = None,
+               message: Optional[str] = None, delay: float = 0.0,
+               seed: int = 0) -> None:
+        """Arm ``name``: fire on the next ``times`` matching hits (None =
+        every hit), each hit firing with probability ``prob`` drawn from a
+        ``seed``-ed RNG.  A firing hit sleeps ``delay`` seconds and, if
+        ``exc`` is set, raises ``exc(message)``."""
+        if name not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown injection point {name!r}; known points: "
+                f"{sorted(KNOWN_POINTS)} (add new ones via register_point)")
+        with self._lock:
+            self._specs[name] = _Spec(times, prob, exc, message, delay, seed)
+
+    def disable(self, name: str) -> None:
+        with self._lock:
+            self._specs.pop(name, None)
+
+    def reset(self) -> None:
+        """Disarm every point and zero the counters."""
+        with self._lock:
+            self._specs.clear()
+            self._hits.clear()
+            self._fired.clear()
+
+    @contextlib.contextmanager
+    def armed(self, name: str, **kwargs: Any) -> Iterator["FaultRegistry"]:
+        """``with registry.armed("serving.conn_drop", times=1): ...`` —
+        scoped enable/disable for tests."""
+        self.enable(name, **kwargs)
+        try:
+            yield self
+        finally:
+            self.disable(name)
+
+    def configure(self, mapping: Optional[Dict[str, Dict[str, Any]]]) -> None:
+        """Arm points from a config dict, e.g. ZooConfig.faults =
+        ``{"serving.queue_reject": {"times": 3, "seed": 7}}``.  Exception
+        types may be given by name ("OSError")."""
+        import builtins
+        for name, kw in (mapping or {}).items():
+            kw = dict(kw)
+            exc = kw.get("exc")
+            if isinstance(exc, str):
+                resolved = getattr(builtins, exc, None)
+                if not (isinstance(resolved, type)
+                        and issubclass(resolved, BaseException)):
+                    raise ValueError(f"faults config: {exc!r} is not an "
+                                     f"exception type")
+                kw["exc"] = resolved
+            self.enable(name, **kw)
+
+    # -- injection points -----------------------------------------------------
+
+    def fire(self, name: str) -> bool:
+        """One hit on point ``name``; True iff the fault fires.  A firing
+        hit consumes one ``times`` charge and sleeps the spec's ``delay``
+        (outside the lock).  Disarmed points cost a lock + two dict ops."""
+        delay = 0.0
+        fired = False
+        with self._lock:
+            self._hits[name] = self._hits.get(name, 0) + 1
+            spec = self._specs.get(name)
+            if spec is not None and (spec.prob >= 1.0
+                                     or spec.rng.random() < spec.prob):
+                fired = True
+                delay = spec.delay
+                self._fired[name] = self._fired.get(name, 0) + 1
+                if spec.times is not None:
+                    spec.times -= 1
+                    if spec.times <= 0:
+                        del self._specs[name]
+        if fired:
+            logger.debug("fault %s fired", name)
+            if delay > 0:
+                time.sleep(delay)
+        return fired
+
+    def raise_if(self, name: str,
+                 default_exc: Type[BaseException] = RuntimeError) -> None:
+        """One hit on ``name``; raises the armed exception type if it fires.
+
+        ``default_exc``: what to raise when the armed spec names no ``exc``
+        — the CALL SITE knows which failure mode it simulates (e.g. the
+        checkpoint writer passes OSError so a config-armed fault exercises
+        the same except-clause a real filesystem blip would)."""
+        with self._lock:
+            spec = self._specs.get(name)
+            exc = (spec.exc if spec is not None and spec.exc is not None
+                   else default_exc)
+            message = (spec.message if spec is not None else None) \
+                or f"injected fault: {name}"
+        if self.fire(name):
+            raise exc(message)
+
+    # -- observability --------------------------------------------------------
+
+    def hits(self, name: str) -> int:
+        """How many times the point was reached (armed or not)."""
+        with self._lock:
+            return self._hits.get(name, 0)
+
+    def fired(self, name: str) -> int:
+        """How many times the point actually fired."""
+        with self._lock:
+            return self._fired.get(name, 0)
+
+    def is_armed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._specs
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """{point: {"hits": n, "fired": m}} for every point ever reached."""
+        with self._lock:
+            return {name: {"hits": self._hits.get(name, 0),
+                           "fired": self._fired.get(name, 0)}
+                    for name in set(self._hits) | set(self._fired)}
+
+
+_REGISTRY = FaultRegistry()
+
+
+def get_registry() -> FaultRegistry:
+    """The process-global registry, the default wiring of every injection
+    point in the framework."""
+    return _REGISTRY

@@ -22,6 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from analytics_zoo_tpu.core.faults import get_registry as _fault_registry
 from .shards import XShards
 
 BATCH_AXES = ("data", "fsdp")  # mesh axes a batch dim is sharded over
@@ -239,6 +240,10 @@ class DataFeed(FeedBase):
 
         pending = shard_batch(host_batch(0), mesh)
         for step in range(steps):
+            # ``feed.stall`` injection point (core/faults.py): an armed
+            # delay models a slow storage read / augmentation hiccup, so
+            # resilience tests can prove training-side timing behavior
+            _fault_registry().fire("feed.stall")
             nxt = (shard_batch(host_batch(step + 1), mesh)
                    if step + 1 < steps else None)
             yield pending

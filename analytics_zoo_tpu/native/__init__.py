@@ -29,9 +29,9 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _build() -> Optional[str]:
-    if os.path.exists(_SO) and (os.path.getmtime(_SO) >=
-                                os.path.getmtime(_SRC)):
+def _build(force: bool = False) -> Optional[str]:
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
         return _SO
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            _SRC, "-o", _SO + ".tmp"]
@@ -45,6 +45,26 @@ def _build() -> Optional[str]:
         return None
 
 
+def _load(so: str) -> Optional[ctypes.CDLL]:
+    """dlopen, tolerating a STALE prebuilt .so (packaged artifact built
+    against a different glibc/toolchain): rebuild from source once and
+    retry; a second failure falls back to the Python queue instead of
+    crashing every import of the serving stack."""
+    try:
+        return ctypes.CDLL(so)
+    except OSError as e:
+        logger.warning("stale native library %s (%s); rebuilding", so, e)
+        so = _build(force=True)
+        if so is None:
+            return None
+        try:
+            return ctypes.CDLL(so)
+        except OSError as e2:
+            logger.warning("rebuilt native library failed to load (%s); "
+                           "using Python fallback queue", e2)
+            return None
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, building it on first use (None if
     unavailable — callers must fall back)."""
@@ -56,7 +76,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if so is None:
             _lib = False
             return None
-        lib = ctypes.CDLL(so)
+        lib = _load(so)
+        if lib is None:
+            _lib = False
+            return None
         lib.zn_queue_create.restype = ctypes.c_void_p
         lib.zn_queue_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
         lib.zn_queue_destroy.argtypes = [ctypes.c_void_p]

@@ -22,6 +22,7 @@ get_model``, with TensorBoard-style summaries and checkpoint triggers.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -109,6 +110,19 @@ class Estimator:
 class ZooEstimator:
     """The single concrete estimator."""
 
+    #: Process-wide device-work lock.  Two estimators dispatching jit
+    #: programs from different threads (automl thread-pool trials)
+    #: intermittently wedge XLA:CPU — observed as trial threads stuck
+    #: forever inside train/eval steps, both at compile AND at plain
+    #: execution.  fit/evaluate/predict therefore serialize their WHOLE
+    #: bodies on this reentrant lock (coarse on purpose: a per-step lock
+    #: would need a device sync inside every step to prevent overlapped
+    #: executions, taxing the single-threaded hot path).  Concurrent
+    #: trials still overlap on everything they do OUTSIDE those calls —
+    #: window rolling, feature prep, metric math in trial_fn — and one
+    #: device computation at a time is the single-TPU-pod reality anyway.
+    _device_lock = threading.RLock()
+
     def __init__(self, model: Module, loss: Any, optimizer: Any = "adam",
                  learning_rate: Optional[Any] = None,
                  metrics: Optional[Sequence[Any]] = None,
@@ -124,7 +138,8 @@ class ZooEstimator:
                  preemption_checkpoint: bool = False,
                  preemption_sync_every: int = 10,
                  frozen: Any = None,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 checkpoint_retries: int = 3):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -163,6 +178,10 @@ class ZooEstimator:
         self.grad_accum = grad_accum
         self.seed = seed
         self.model_dir = model_dir
+        # transient checkpoint-write failures (shared-filesystem blips)
+        # are retried with backoff before a save gives up — critical for
+        # the preemption window, where there is no second chance
+        self.checkpoint_retries = max(1, checkpoint_retries)
         self._writer = (SummaryWriter(log_dir, app_name)
                         if log_dir else None)
         self._ts: Optional[Dict[str, Any]] = None  # train state pytree
@@ -385,10 +404,13 @@ class ZooEstimator:
 
         if self._preempt is not None:
             self._preempt.active = True
+        ZooEstimator._device_lock.acquire()
         try:
             first = True
             for _ in range(epochs):
-                t0 = time.time()
+                # monotonic: a wall-clock step (NTP) mid-epoch must not
+                # produce negative or wildly wrong throughput numbers
+                t0 = time.monotonic()
                 losses = []
                 for batch in feed.epoch(mesh, self._epoch):
                     if "mask" in batch:
@@ -428,7 +450,7 @@ class ZooEstimator:
                 # on device
                 epoch_loss = float(jnp.stack(losses).mean())
                 history["loss"].append(epoch_loss)
-                dt = time.time() - t0
+                dt = time.monotonic() - t0
                 n = len(losses) * feed.global_batch
                 if self._writer:
                     self._writer.add_scalar("loss", epoch_loss, self._epoch)
@@ -449,6 +471,7 @@ class ZooEstimator:
                     self.save(self.model_dir)
             self._stop_profile()  # short runs: close the trace at fit end
         finally:
+            ZooEstimator._device_lock.release()
             if self._preempt is not None:
                 self._preempt.active = False
         return history
@@ -499,24 +522,26 @@ class ZooEstimator:
 
         # shuffled feeds are fine: sums are permutation-invariant and
         # step_mask zero-weights the padded tail positions either way
-        for step, batch in enumerate(feed.epoch(mesh, 0)):
-            totals = accumulate(totals, batch, step)
-        if feed.drop_remainder:
-            # user-constructed training feed: cover the dropped tail with a
-            # padded + masked extra batch.  dropped_rows respects the
-            # epoch-0 permutation, so shuffled feeds are exact too.
-            rem = (feed.dropped_rows(0) if hasattr(feed, "dropped_rows")
-                   else feed.remainder())
-            if rem is not None:
-                totals = accumulate(totals,
-                                    _pad_remainder(rem, feed, mesh), -1)
-            elif (getattr(feed, "shuffle", False)
-                  and feed.num_rows % getattr(feed, "_local_batch", 1)):
-                # rows WERE dropped and this feed can't reconstruct them
-                logger.warning(
-                    "evaluate on a shuffled drop_remainder feed that cannot "
-                    "reconstruct its dropped rows: metrics exclude the rows "
-                    "the shuffle dropped this epoch")
+        with ZooEstimator._device_lock:
+            for step, batch in enumerate(feed.epoch(mesh, 0)):
+                totals = accumulate(totals, batch, step)
+            if feed.drop_remainder:
+                # user-constructed training feed: cover the dropped tail
+                # with a padded + masked extra batch.  dropped_rows
+                # respects the epoch-0 permutation, so shuffled feeds are
+                # exact too.
+                rem = (feed.dropped_rows(0) if hasattr(feed, "dropped_rows")
+                       else feed.remainder())
+                if rem is not None:
+                    totals = accumulate(totals,
+                                        _pad_remainder(rem, feed, mesh), -1)
+                elif (getattr(feed, "shuffle", False)
+                      and feed.num_rows % getattr(feed, "_local_batch", 1)):
+                    # rows WERE dropped; this feed can't reconstruct them
+                    logger.warning(
+                        "evaluate on a shuffled drop_remainder feed that "
+                        "cannot reconstruct its dropped rows: metrics "
+                        "exclude the rows the shuffle dropped this epoch")
         if totals is None:
             raise ValueError("evaluate got no batches")
         out = {"loss": float(totals[0][0] / jnp.maximum(totals[0][1], 1.0))}
@@ -542,16 +567,18 @@ class ZooEstimator:
                 "predict needs row order preserved: construct the feed with "
                 "shuffle=False")
         outs: List[np.ndarray] = []
-        for batch in feed.epoch(mesh, 0):
-            self._ensure_initialized(batch["x"])
-            outs.append(_to_local_rows(self._pred_step(self._ts,
-                                                       batch["x"])))
-        if getattr(feed, "drop_remainder", False):
-            rem = feed.remainder()
-            if rem is not None:  # tail rows the epoch skipped (replicated)
-                x = jax.tree_util.tree_map(jnp.asarray, rem["x"])
-                self._ensure_initialized(x)
-                outs.append(_to_local_rows(self._pred_step(self._ts, x)))
+        with ZooEstimator._device_lock:
+            for batch in feed.epoch(mesh, 0):
+                self._ensure_initialized(batch["x"])
+                outs.append(_to_local_rows(self._pred_step(self._ts,
+                                                           batch["x"])))
+            if getattr(feed, "drop_remainder", False):
+                rem = feed.remainder()
+                if rem is not None:  # tail rows the epoch skipped
+                    x = jax.tree_util.tree_map(jnp.asarray, rem["x"])
+                    self._ensure_initialized(x)
+                    outs.append(_to_local_rows(self._pred_step(self._ts,
+                                                               x)))
         return np.concatenate(outs, axis=0)[: feed.num_rows]
 
     # -- persistence ----------------------------------------------------------
@@ -562,11 +589,21 @@ class ZooEstimator:
             raise ValueError("no path given and no model_dir configured")
         if self._ts is None:
             raise ValueError("nothing to save: model not initialized yet")
-        tree = jax.tree_util.tree_map(lambda x: x, self._ts)
-        return ckpt_io.save(path, tree, step=int(self._ts["step"]),
-                            extra={"epoch": int(self._epoch)})
+        with ZooEstimator._device_lock:  # device_get sweeps device state
+            tree = jax.tree_util.tree_map(lambda x: x, self._ts)
+            return ckpt_io.save(path, tree, step=int(self._ts["step"]),
+                                extra={"epoch": int(self._epoch)},
+                                retries=self.checkpoint_retries)
 
     def load(self, path: Optional[str] = None) -> None:
+        # under the device lock: restore dispatches device_put/jit work,
+        # and a concurrent trial mid-fit must not overlap it (the same
+        # XLA:CPU wedge _device_lock exists for; RLock, so fit's own
+        # auto_resume load and trigger saves re-enter fine)
+        with ZooEstimator._device_lock:
+            self._load_locked(path)
+
+    def _load_locked(self, path: Optional[str]) -> None:
         path = path or self.model_dir
         mesh = get_mesh()
         # mesh-aware restore: leaves that were sharded at save time come

@@ -3,45 +3,128 @@ pushed b64-Arrow ndarrays into Redis, OutputQueue polled result keys).
 
 Same two-class API over the TCP frame protocol; one connection carries both
 directions, results are matched by uuid.
+
+Resilience (ISSUE 1): the reference leaned on Redis persistence + Flink
+restarts to ride out worker loss; here the client itself is the retry
+layer.  A connection that dies (server restart, injected
+``serving.conn_drop``) is re-established with exponential backoff +
+jitter, and the in-flight request is re-enqueued VERBATIM under its
+original uuid — inference is deterministic, so a duplicate run returns
+the same answer and the re-enqueue is idempotent from the caller's view.
+Retryable server errors ("queue full" backpressure, "server shutting
+down" drain) are retried the same way, bounded by the ``RetryPolicy``.
+A per-request deadline rides in the frame header (``deadline_ms``) so
+the server can shed the request instead of serving a reply nobody is
+waiting for.
 """
 
 from __future__ import annotations
 
+import logging
+import random
 import socket
 import threading
+import time
 import uuid as uuid_mod
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from . import protocol
 
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: Server error replies that mean "try again", not "your request is bad".
+RETRYABLE_ERRORS = ("queue full", "server shutting down")
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic, seedable jitter.
+
+    ``max_attempts`` counts every try including the first; delays grow
+    ``base_delay * 2^k`` capped at ``max_delay``, each multiplied by a
+    jitter factor drawn uniformly from [1-jitter, 1+jitter] using a
+    ``random.Random(seed)`` so tests replay exactly."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5
+    seed: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        raw = min(self.base_delay * (2 ** max(0, attempt - 1)),
+                  self.max_delay)
+        lo, hi = 1.0 - self.jitter, 1.0 + self.jitter
+        return raw * self._rng.uniform(max(0.0, lo), hi)
+
 
 class _Conn:
-    """Shared connection + background reader demuxing replies by uuid."""
+    """Shared connection + background reader demuxing replies by uuid,
+    with reconnect + idempotent resend of in-flight frames."""
 
     #: replies for abandoned uuids (query timed out before the server
     #: answered) are evicted oldest-first beyond this bound
     MAX_UNCLAIMED = 1024
+    #: in-flight frames kept for resend are evicted the same way, bounded
+    #: both by count and by total bytes (frames hold the full encoded
+    #: tensor; large batches must not double the client's memory without
+    #: limit).  An evicted request loses its recovery path — logged when
+    #: that actually bites (see resend).
+    MAX_INFLIGHT = 1024
+    MAX_INFLIGHT_BYTES = 64 * 1024 * 1024
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        # the timeout bounds connect only; left on the socket it would kill
-        # the background reader after any 30s idle gap (recv raises, thread
-        # exits, every later query returns None)
-        self.sock.settimeout(None)
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
+        self.host, self.port = host, port
+        self.connect_timeout = timeout
+        self.retry = retry or RetryPolicy()
         # insertion-ordered (dicts are), so eviction drops the oldest
         self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str]]]
         self._results = {}
+        self._inflight: Dict[str, bytes] = {}  # uuid -> encoded frame
+        self._inflight_bytes = 0
+        self._generation = 0  # bumped per successful (re)connect
         self._cond = threading.Condition()
         self._send_lock = threading.Lock()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._conn_lock = threading.Lock()  # serializes reconnects
+        self._closed = False
+        self.stats = {"reconnects": 0, "resends": 0, "retries": 0}
+        self.sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._connect()
+
+    # -- connection lifecycle --------------------------------------------------
+
+    def _connect(self) -> None:
+        """One connection attempt (raises OSError on failure)."""
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.connect_timeout)
+        # the timeout bounds connect only; left on the socket it would kill
+        # the background reader after any 30s idle gap (recv raises, thread
+        # exits, every later query returns None)
+        sock.settimeout(None)
+        self.sock = sock
+        self._generation += 1
+        # reader binds the socket as an argument: a stale reader from a
+        # previous connection must never recv() from the new socket
+        self._reader = threading.Thread(target=self._read_loop,
+                                        args=(sock,), daemon=True)
         self._reader.start()
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                frame = protocol.recv_frame(self.sock)
+                frame = protocol.recv_frame(sock)
                 if frame is None:
                     return
                 header, arr = protocol.decode(frame)
@@ -51,18 +134,130 @@ class _Conn:
                     while len(self._results) > self.MAX_UNCLAIMED:
                         self._results.pop(next(iter(self._results)))
                     self._cond.notify_all()
-        except OSError:
+        except (OSError, ValueError):
             pass
 
+    @property
+    def alive(self) -> bool:
+        """The reader thread exits exactly when the server closes (or
+        resets) its end — the reliable liveness signal; a dead peer is NOT
+        reliably visible on send (the first write after a remote close
+        succeeds)."""
+        return self._reader is not None and self._reader.is_alive()
+
+    def reconnect(self) -> None:
+        """Re-establish the connection with bounded backoff + jitter.
+        Raises the last OSError when every attempt fails."""
+        with self._conn_lock:
+            if self._closed:
+                raise OSError("connection closed by caller")
+            if self.alive:
+                return  # another thread already reconnected
+            last: Optional[OSError] = None
+            for attempt in range(1, self.retry.max_attempts + 1):
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                try:
+                    self._connect()
+                    self.stats["reconnects"] += 1
+                    logger.debug("reconnected to %s:%d (attempt %d)",
+                                 self.host, self.port, attempt)
+                    self._replay_inflight()
+                    return
+                except OSError as e:
+                    last = e
+                    if attempt < self.retry.max_attempts:
+                        time.sleep(self.retry.delay(attempt))
+            raise OSError(
+                f"could not reconnect to {self.host}:{self.port} after "
+                f"{self.retry.max_attempts} attempts: {last}") from last
+
+    def _replay_inflight(self) -> None:
+        """Re-enqueue EVERY recorded in-flight frame on a fresh connection.
+        Requests from other threads sharing this connection died with the
+        old socket too — without a full replay, only the thread that
+        noticed the dead reader would retry, and the rest would silently
+        wait out their timeouts.  Duplicates are harmless: replies key on
+        uuid and inference is deterministic."""
+        with self._cond:
+            frames = list(self._inflight.values())
+        for frame in frames:
+            try:
+                with self._send_lock:
+                    protocol.send_frame(self.sock, frame)
+                self.stats["resends"] += 1
+            except OSError:
+                return  # died again: the next liveness check handles it
+
     def close(self) -> None:
+        self._closed = True
         try:
             self.sock.close()
         except OSError:
             pass
 
-    def send(self, header, arr) -> None:
-        with self._send_lock:
-            protocol.send_frame(self.sock, protocol.encode(header, arr))
+    # -- sending ---------------------------------------------------------------
+
+    def send_request(self, header: Dict, arr: Optional[np.ndarray]) -> None:
+        """Encode + send a request frame, recording it for idempotent
+        resend; reconnects with backoff on a dead socket."""
+        frame = protocol.encode(header, arr)
+        uid = header["uuid"]
+        with self._cond:
+            self._inflight[uid] = frame
+            self._inflight_bytes += len(frame)
+            while (len(self._inflight) > self.MAX_INFLIGHT
+                   or self._inflight_bytes > self.MAX_INFLIGHT_BYTES):
+                dropped = self._inflight.pop(next(iter(self._inflight)))
+                self._inflight_bytes -= len(dropped)
+        self._send_frame_with_retry(uid, frame)
+
+    def resend(self, uid: str) -> bool:
+        """Re-enqueue the recorded in-flight frame for ``uid`` (same uuid:
+        the server's reply keying makes the retry idempotent).  False if
+        the frame is no longer recorded (evicted or already answered)."""
+        with self._cond:
+            frame = self._inflight.get(uid)
+        if frame is None:
+            logger.warning(
+                "request %s cannot be retried: its frame was evicted from "
+                "the in-flight record (raise _Conn.MAX_INFLIGHT[_BYTES] if "
+                "this client legitimately keeps that many outstanding)",
+                uid)
+            return False
+        if self._send_frame_with_retry(uid, frame):
+            self.stats["resends"] += 1  # replay-carried sends count there
+        return True
+
+    def _send_frame_with_retry(self, uid: str, frame: bytes) -> bool:
+        """Send ``frame``, reconnecting on a dead socket.  Returns False
+        when a reconnect's inflight replay already carried the frame (so
+        callers don't send — or count — a duplicate), True otherwise."""
+        last: Optional[OSError] = None
+        for attempt in range(1, self.retry.max_attempts + 1):
+            if not self.alive:
+                gen = self._generation
+                self.reconnect()  # raises after its own bounded attempts
+                with self._cond:
+                    replayed = (self._generation != gen
+                                and uid in self._inflight)
+                if replayed:
+                    return False  # _replay_inflight carried this frame
+            try:
+                with self._send_lock:
+                    protocol.send_frame(self.sock, frame)
+                return True
+            except OSError as e:
+                last = e
+                self.stats["retries"] += 1
+                if attempt < self.retry.max_attempts:
+                    time.sleep(self.retry.delay(attempt))
+        raise OSError(f"send failed after {self.retry.max_attempts} "
+                      f"attempts: {last}") from last
+
+    # -- receiving -------------------------------------------------------------
 
     def wait(self, uid: str, timeout: Optional[float]
              ) -> Optional[Tuple[Optional[np.ndarray], Optional[str]]]:
@@ -71,31 +266,52 @@ class _Conn:
                                      timeout=timeout)
             if not ok:
                 return None
+            # the resend record stays until the caller accepts the reply
+            # (query retries "queue full" replies by resending it)
             return self._results.pop(uid)
 
     def peek(self, uid: str):
         with self._cond:
             return self._results.pop(uid, None)
 
+    def forget(self, uid: str) -> None:
+        """Drop the resend record (request answered, or caller gave up)."""
+        with self._cond:
+            frame = self._inflight.pop(uid, None)
+            if frame is not None:
+                self._inflight_bytes -= len(frame)
+
 
 class InputQueue:
     """``enqueue(name, t=ndarray)`` → uuid (reference API shape)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8980,
-                 frontend_url: Optional[str] = None):
+                 frontend_url: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None):
         if frontend_url:  # "host:port" parity with the reference's url conf
             host, port_s = frontend_url.rsplit(":", 1)
             port = int(port_s)
-        self._conn = _Conn(host, port)
+        self._conn = _Conn(host, port, retry=retry)
 
-    def enqueue(self, name: str, **kwargs: np.ndarray) -> str:
+    def enqueue(self, name: str, deadline: Optional[float] = None,
+                **kwargs: np.ndarray) -> str:
+        """Send one named tensor; returns the uuid to ``query`` on.
+
+        ``deadline``: optional per-request budget in SECONDS, carried to
+        the server as ``deadline_ms`` in the frame header.  The server
+        sheds the request (error reply "deadline exceeded") instead of
+        running inference once the budget is spent.  Retries restamp the
+        full budget — the server re-anchors it at arrival, so clocks never
+        need to agree across hosts."""
         if len(kwargs) != 1:
             raise ValueError("exactly one named tensor per enqueue "
                              "(reference: t=ndarray)")
         (_, arr), = kwargs.items()
         uid = f"{name}-{uuid_mod.uuid4()}"
-        self._conn.send({"uuid": uid},
-                        np.asarray(arr))
+        header: Dict = {"uuid": uid}
+        if deadline is not None:
+            header["deadline_ms"] = max(1, int(deadline * 1000))
+        self._conn.send_request(header, np.asarray(arr))
         return uid
 
     def close(self) -> None:
@@ -109,19 +325,69 @@ class InputQueue:
 class OutputQueue:
     """``query(uuid)`` / ``dequeue()`` (reference API shape)."""
 
+    #: how often a blocked query re-checks connection liveness
+    _POLL = 0.25
+
     def __init__(self, input_queue: Optional[InputQueue] = None,
-                 host: str = "127.0.0.1", port: int = 8980):
+                 host: str = "127.0.0.1", port: int = 8980,
+                 retry: Optional[RetryPolicy] = None):
         if input_queue is not None:
             self._conn = input_queue.conn
         else:
-            self._conn = _Conn(host, port)
+            self._conn = _Conn(host, port, retry=retry)
 
     def query(self, uid: str, timeout: Optional[float] = 30.0
               ) -> Optional[np.ndarray]:
-        res = self._conn.wait(uid, timeout)
-        if res is None:
-            return None
-        arr, err = res
-        if err:
+        """The reply for ``uid``; None on timeout.
+
+        Survives a server restart mid-wait: a dead connection is
+        re-established (backoff + jitter) and the recorded request frame is
+        re-enqueued under the SAME uuid.  Retryable error replies
+        ("queue full", "server shutting down") are retried the same way,
+        bounded by the connection's RetryPolicy; other errors raise."""
+        conn = self._conn
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        error_retries = 0
+        while True:
+            left = (None if deadline is None
+                    else deadline - time.monotonic())
+            if left is not None and left <= 0:
+                conn.forget(uid)
+                return None
+            # wait in slices so a dead reader is noticed promptly even
+            # when the reply will never come
+            slice_t = self._POLL if left is None else min(self._POLL, left)
+            res = conn.wait(uid, slice_t)
+            if res is None:
+                if not conn.alive:
+                    try:
+                        if not conn.resend(uid):
+                            return None  # nothing recorded to retry
+                    except OSError:
+                        conn.forget(uid)
+                        raise
+                continue
+            arr, err = res
+            if err is None:
+                conn.forget(uid)
+                return arr
+            if (any(m in err for m in RETRYABLE_ERRORS)
+                    and error_retries + 1 < conn.retry.max_attempts):
+                error_retries += 1
+                conn.stats["retries"] += 1
+                # never sleep past the caller's deadline: cap the backoff
+                # at the remaining budget (the loop top then times out)
+                delay = conn.retry.delay(error_retries)
+                if deadline is not None:
+                    delay = min(delay,
+                                max(0.0, deadline - time.monotonic()))
+                time.sleep(delay)
+                try:
+                    if conn.resend(uid):
+                        continue
+                except OSError:
+                    conn.forget(uid)
+                    raise
+            conn.forget(uid)
             raise RuntimeError(f"serving error for {uid}: {err}")
-        return arr

@@ -12,9 +12,19 @@ executables with every other client instead of owning a second inference
 path.
 
 Endpoints (TF-Serving-flavored JSON):
-  POST /predict   {"instances": <nested list>, "dtype": "float32"?}
+  POST /predict   {"instances": <nested list>, "dtype": "float32"?,
+                   "deadline_ms": <int>?}
                   → {"predictions": <nested list>}
   GET  /health    → {"status": "ok"}
+  GET  /stats     → request/error/timeout counters + the backend
+                    connection's reconnect/resend/retry counters
+
+Failure semantics: a per-request deadline (``deadline_ms`` in the JSON
+body, or the ``X-Deadline-Ms`` header) is propagated to the serving
+backend in the frame header; the backend sheds the request once the
+budget is spent and the frontend answers 504.  Backend restarts are
+ridden out by the resilient client underneath (reconnect with backoff +
+idempotent re-enqueue) — the counters for that surface in ``/stats``.
 """
 
 from __future__ import annotations
@@ -39,11 +49,11 @@ class HTTPFrontend:
                  serving_port: int = 8980, host: str = "127.0.0.1",
                  port: int = 0, query_timeout: float = 30.0):
         self._serving_addr = (serving_host, serving_port)
-        self._conn_lock = threading.Lock()
         self._connect()
         self.query_timeout = query_timeout
         self._stats_lock = threading.Lock()
-        self._stats = {"requests": 0, "errors": 0, "timeouts": 0}
+        self._stats = {"requests": 0, "errors": 0, "timeouts": 0,
+                       "deadline_exceeded": 0, "rejected": 0}
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -64,6 +74,9 @@ class HTTPFrontend:
                 elif self.path == "/stats":
                     with frontend._stats_lock:  # copy only; write outside
                         snapshot = dict(frontend._stats)
+                    # the resilient client's counters: how hard the
+                    # frontend is working to keep its backend connection
+                    snapshot.update(frontend._in.conn.stats)
                     self._json(200, snapshot)
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
@@ -78,13 +91,25 @@ class HTTPFrontend:
                     req = json.loads(self.rfile.read(n) or b"{}")
                     arr = np.asarray(req["instances"],
                                      dtype=req.get("dtype", "float32"))
+                    deadline_ms = req.get("deadline_ms",
+                                          self.headers.get("X-Deadline-Ms"))
+                    deadline = (float(deadline_ms) / 1000.0
+                                if deadline_ms is not None else None)
                 except (KeyError, ValueError, TypeError) as e:
                     frontend._bump("errors")
                     self._json(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    out = frontend.predict(arr)
+                    out = frontend.predict(arr, deadline=deadline)
                 except RuntimeError as e:  # serving-side error reply
+                    if "deadline exceeded" in str(e):
+                        frontend._bump("deadline_exceeded")
+                        self._json(504, {"error": str(e)})
+                        return
+                    if "queue full" in str(e):
+                        frontend._bump("rejected")
+                        self._json(503, {"error": str(e)})
+                        return
                     frontend._bump("errors")
                     self._json(500, {"error": str(e)})
                     return
@@ -110,34 +135,21 @@ class HTTPFrontend:
         self._in = InputQueue(*self._serving_addr)
         self._out = OutputQueue(input_queue=self._in)
 
-    def _reconnect(self) -> None:
-        with self._conn_lock:
-            old = self._in
-            self._connect()
-            old.close()
-
-    def predict(self, arr: np.ndarray) -> Optional[np.ndarray]:
-        """One request through the shared connection; if the backend went
-        away (ClusterServing restart), reconnect once and retry.
-
-        A dead TCP peer is NOT reliably visible on send (the first write
-        after a remote close succeeds), so liveness is judged by the
-        connection's reader thread: it exits exactly when the server closes
-        its end."""
-        if not self._in.conn._reader.is_alive():
-            self._reconnect()  # raises OSError if the backend is still down
-        try:
-            uid = self._in.enqueue("http", t=arr)
-        except OSError:
-            self._reconnect()
-            uid = self._in.enqueue("http", t=arr)
-        out = self._out.query(uid, timeout=self.query_timeout)
-        if out is None and not self._in.conn._reader.is_alive():
-            # the send landed on a dying socket; one retry on a fresh one
-            self._reconnect()
-            uid = self._in.enqueue("http", t=arr)
-            out = self._out.query(uid, timeout=self.query_timeout)
-        return out
+    def predict(self, arr: np.ndarray,
+                deadline: Optional[float] = None) -> Optional[np.ndarray]:
+        """One request through the shared connection.  Reconnect-with-
+        backoff, idempotent re-enqueue and retryable-error handling all
+        live in the resilient client underneath (serving/client.py) — a
+        backend restart surfaces here only as a slightly slower reply.
+        ``deadline`` (seconds) rides to the server so an expired request
+        is shed instead of served."""
+        # wait a grace window past the deadline: the shed happens when the
+        # batcher reaches the request, and its explicit "deadline exceeded"
+        # reply beats an anonymous client-side timeout as the 504 reason
+        timeout = (self.query_timeout if deadline is None
+                   else min(self.query_timeout, deadline + 1.0))
+        uid = self._in.enqueue("http", deadline=deadline, t=arr)
+        return self._out.query(uid, timeout=timeout)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -243,7 +243,13 @@ class InferenceModel:
             self._variables)
         jaxpr = jax.make_jaxpr(self._fwd_for_export())(
             var_struct, jax.ShapeDtypeStruct(shape, np.dtype(dtype)))
-        return hashlib.sha256(str(jaxpr).encode()).hexdigest()[:16]
+        # the printed jaxpr embeds repr()s of closure params (e.g.
+        # custom_jvp's jvp_jaxpr_thunk=<function ... at 0x...>) whose
+        # MEMORY ADDRESSES differ every trace — strip them or the hash
+        # never matches across processes and every artifact is "stale"
+        import re
+        text = re.sub(r" at 0x[0-9a-fA-F]+", "", str(jaxpr))
+        return hashlib.sha256(text.encode()).hexdigest()[:16]
 
     def save_executables(self, path: str) -> int:
         """Serialize the per-shape serving computations (jax.export

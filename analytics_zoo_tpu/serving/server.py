@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.core.faults import FaultRegistry, get_registry
 from analytics_zoo_tpu.native import NativeQueue
 from .inference_model import InferenceModel
 from . import protocol
@@ -33,14 +34,17 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 
 class _Pending:
-    __slots__ = ("uuid", "arr", "conn", "lock")
+    __slots__ = ("uuid", "arr", "conn", "lock", "expires")
 
     def __init__(self, uid: str, arr: np.ndarray, conn: socket.socket,
-                 lock: threading.Lock):
+                 lock: threading.Lock, expires: Optional[float] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
         self.lock = lock
+        # absolute time.monotonic() deadline (from the client's
+        # ``deadline_ms`` budget, re-anchored at arrival); None = no limit
+        self.expires = expires
 
 
 class ClusterServing:
@@ -50,11 +54,13 @@ class ClusterServing:
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
                  port: int = 0, batch_size: int = 16,
                  batch_timeout_ms: int = 5, queue_items: int = 4096,
-                 push_timeout: float = 5.0):
+                 push_timeout: float = 5.0,
+                 faults: Optional[FaultRegistry] = None):
         self.model = model
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
         self.push_timeout = push_timeout  # how long accept blocks when full
+        self._faults = faults or get_registry()
         self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
         self._pending: Dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
@@ -66,12 +72,17 @@ class ClusterServing:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
+        self._conns: set = set()  # open client sockets, for drain/close
         # observability (reference: the Flink job's metrics): monotonically
         # increasing counters, read via stats().  Invariant on a healthy
         # server: requests == replies + errors once in-flight work drains.
+        # errors subsumes rejected (queue full), shed (deadline exceeded)
+        # and drained (stop() replied "server shutting down").
         self._stats_lock = threading.Lock()
         self._counters = {"requests": 0, "replies": 0, "batches": 0,
-                          "errors": 0, "batch_rows": 0}
+                          "errors": 0, "batch_rows": 0, "rejected": 0,
+                          "shed": 0, "drained": 0}
 
     def update_model(self, model: InferenceModel) -> None:
         """Hot-swap the serving model without dropping connections
@@ -100,17 +111,27 @@ class ClusterServing:
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "ClusterServing":
-        t_accept = threading.Thread(target=self._accept_loop, daemon=True)
-        t_batch = threading.Thread(target=self._batch_loop, daemon=True)
+        t_accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                    name="zoo-serving-accept")
+        t_batch = threading.Thread(target=self._batch_loop, daemon=True,
+                                   name="zoo-serving-batch")
+        with self._threads_lock:
+            self._threads = [t_accept, t_batch]
         t_accept.start()
         t_batch.start()
-        self._threads = [t_accept, t_batch]
         logger.info("ClusterServing listening on %s:%d (batch=%d, native "
                     "queue=%s)", self.host, self.port, self.batch_size,
                     self._queue.is_native)
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 5.0) -> None:
+        """Graceful drain: stop intake, join worker threads, reply
+        ``server shutting down`` to every request still pending (so no
+        client hangs until its own timeout), then close client sockets.
+
+        Idempotent — the second and later calls are no-ops."""
+        if self._stop.is_set():
+            return
         self._stop.set()
         self._queue.close()
         try:
@@ -124,6 +145,39 @@ class ClusterServing:
             self._sock.close()
         except OSError:
             pass
+        # join the acceptor + batcher first: the batcher finishes (and
+        # replies to) its in-flight batch, so the drain below only sees
+        # requests that never reached the model
+        with self._threads_lock:
+            workers = list(self._threads)
+        for t in workers:
+            t.join(timeout=drain_timeout)
+            if t.is_alive():
+                logger.warning("ClusterServing.stop: thread %s did not "
+                               "exit within %.1fs", t.name, drain_timeout)
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        if pending:
+            self._count(errors=len(pending), drained=len(pending))
+            for p in pending:
+                self._reply(p, {"uuid": p.uuid,
+                                "error": "server shutting down"}, None)
+            logger.info("ClusterServing.stop: drained %d pending "
+                        "request(s)", len(pending))
+        # only now close client connections: the drain replies above must
+        # reach their sockets first
+        with self._threads_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self.start()
@@ -139,8 +193,11 @@ class ClusterServing:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 daemon=True, name="zoo-serving-conn")
+            with self._threads_lock:
+                self._conns.add(conn)
+            t.start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
@@ -148,6 +205,12 @@ class ClusterServing:
             while not self._stop.is_set():
                 frame = protocol.recv_frame(conn)
                 if frame is None:
+                    return
+                if self._faults.fire("serving.conn_drop"):
+                    # injected transient network fault: the request (and
+                    # this connection) vanish without a reply — clients
+                    # must recover via reconnect + idempotent re-enqueue
+                    logger.debug("fault: dropping connection")
                     return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())
@@ -161,16 +224,23 @@ class ClusterServing:
                         protocol.send_frame(conn, protocol.encode(
                             {"uuid": uid, "error": "no tensor in request"}))
                     continue
+                # deadline_ms is a RELATIVE budget re-anchored at arrival:
+                # client and server clocks never need to agree
+                deadline_ms = header.get("deadline_ms")
+                expires = (time.monotonic() + deadline_ms / 1000.0
+                           if deadline_ms is not None else None)
                 with self._pending_lock:
                     rid = self._next_id
                     self._next_id += 1
-                    self._pending[rid] = _Pending(uid, arr, conn, send_lock)
-                ok = self._queue.push(rid.to_bytes(8, "big"),
-                                      timeout=self.push_timeout)
+                    self._pending[rid] = _Pending(uid, arr, conn, send_lock,
+                                                  expires)
+                ok = (not self._faults.fire("serving.queue_reject")
+                      and self._queue.push(rid.to_bytes(8, "big"),
+                                           timeout=self.push_timeout))
                 if not ok:  # back-pressure: reject instead of dropping
                     with self._pending_lock:
                         self._pending.pop(rid, None)
-                    self._count(errors=1)
+                    self._count(errors=1, rejected=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
                             {"uuid": uid, "error": "queue full"}))
@@ -179,6 +249,8 @@ class ClusterServing:
         except RuntimeError:
             pass  # queue closed: server is stopping
         finally:
+            with self._threads_lock:
+                self._conns.discard(conn)
             conn.close()
 
     # -- stage 2: batch + infer ----------------------------------------------
@@ -193,9 +265,12 @@ class ClusterServing:
             if item is None:
                 continue
             batch.append(self._take(item[0]))
-            deadline = time.time() + self.batch_timeout_ms / 1000.0
+            # monotonic, not wall-clock: an NTP step backwards would hold
+            # the window open (starving the batch) and a step forwards
+            # would close it instantly on every iteration
+            deadline = time.monotonic() + self.batch_timeout_ms / 1000.0
             while len(batch) < self.batch_size:
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     break
                 try:
@@ -205,7 +280,7 @@ class ClusterServing:
                 if item is None:
                     break
                 batch.append(self._take(item[0]))
-            batch = [p for p in batch if p is not None]
+            batch = self._shed_expired([p for p in batch if p is not None])
             if not batch:
                 continue
             try:
@@ -221,7 +296,26 @@ class ClusterServing:
         with self._pending_lock:
             return self._pending.pop(rid, None)
 
+    def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
+        """Drop requests whose deadline already passed — running inference
+        for a client that stopped waiting wastes TPU time AND delays every
+        live request behind it.  Shed requests get an explicit error reply
+        (the client's query raises instead of timing out)."""
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in batch:
+            if p.expires is not None and p.expires < now:
+                self._count(errors=1, shed=1)
+                self._reply(p, {"uuid": p.uuid,
+                                "error": "deadline exceeded"}, None)
+            else:
+                live.append(p)
+        return live
+
     def _run_batch(self, batch: List[_Pending]) -> None:
+        # injected latency (armed spec's ``delay``) lands here, before the
+        # model call — the knob deadline/shedding tests turn
+        self._faults.fire("serving.model_latency")
         # group by input shape (mixed-shape requests can't stack)
         groups: Dict[Tuple, List[_Pending]] = {}
         for p in batch:

@@ -57,7 +57,10 @@ def _run_module(path: str) -> dict:
     cmd = [sys.executable, "-m", "pytest", path, "-q", "--no-header",
            # dump all thread stacks if a test wedges (leaves 60s for
            # pytest teardown before our subprocess leash fires)
-           "-o", f"faulthandler_timeout={timeout - 60}"]
+           "-o", f"faulthandler_timeout={timeout - 60}",
+           # an unregistered marker is a silent tier-1 filter bypass
+           # (`-m 'not slow'` can't deselect a typo'd mark) — fail fast
+           "-W", "error::pytest.PytestUnknownMarkWarning"]
     t0 = time.perf_counter()
     # Popen + communicate (not subprocess.run): on timeout, run() discards
     # the pipe contents, losing the faulthandler dump this runner exists

@@ -68,8 +68,12 @@ def test_psum_on_mesh():
     def f(v):
         return jax.lax.psum(v.sum(), "data")
 
+    try:
+        from jax import shard_map  # jax >= 0.4.35: top-level callable
+    except ImportError:  # older jax: the experimental namespace
+        from jax.experimental.shard_map import shard_map
     out = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=P("data", None), out_specs=P())
+        shard_map(f, mesh=mesh, in_specs=P("data", None), out_specs=P())
     )(xs)
     assert float(out) == x.sum()
 

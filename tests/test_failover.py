@@ -48,8 +48,13 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
     assert preempted_step > 0
     assert (model_dir / "treedef.json").exists()
 
-    # phase 2: fresh process auto-resumes past the preempted step
-    proc2 = _spawn(model_dir, "1")
+    # phase 2: fresh process auto-resumes past the preempted step.
+    # ``epochs`` is a TOTAL target, so derive it from the checkpoint's
+    # saved epoch — a fixed "1" trains ZERO further epochs whenever the
+    # fast phase-1 run already got past epoch 1 before the signal landed
+    from analytics_zoo_tpu.core import checkpoint as ckpt_io
+    saved_epoch = ckpt_io.load_extra(str(model_dir)).get("epoch", 0)
+    proc2 = _spawn(model_dir, str(saved_epoch + 2))
     out2, _ = proc2.communicate(timeout=180)
     assert proc2.returncode == 0, out2[-3000:]
     m2 = re.search(r"FINISHED step=(\d+)", out2)
@@ -91,6 +96,76 @@ def test_preemption_requires_model_dir():
     with pytest.raises(ValueError, match="model_dir"):
         Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse",
                              preemption_checkpoint=True)
+
+def test_guard_inactive_signal_chains_to_callable_prev():
+    """A signal while active=False must re-raise through the PREVIOUS
+    handler when that handler is a plain callable (e.g. an application's
+    own SIGTERM hook), and must NOT set the checkpoint flag."""
+    from analytics_zoo_tpu.core import PreemptionGuard
+    calls = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: calls.append(s))
+    g = PreemptionGuard(sync_every=2).install()
+    try:
+        assert g.active is False
+        g._on_signal(signal.SIGTERM, None)
+        assert calls == [signal.SIGTERM]  # chained, not swallowed
+        assert not g.flagged
+        # a second delivery chains again (the guard stays installed)
+        g._on_signal(signal.SIGTERM, None)
+        assert calls == [signal.SIGTERM] * 2
+    finally:
+        g.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_guard_inactive_signal_sig_dfl_reraises():
+    """When the previous handler was SIG_DFL the guard must restore
+    SIG_DFL and re-raise the signal so the default action runs (for
+    SIGTERM: process death).  Verified with the signal plumbing mocked —
+    letting the default action run would kill pytest."""
+    from unittest import mock
+    from analytics_zoo_tpu.core import PreemptionGuard
+    from analytics_zoo_tpu.core import failover
+    g = PreemptionGuard(sync_every=2)
+    g._prev_handlers[signal.SIGTERM] = signal.SIG_DFL
+    g._installed = True
+    try:
+        with mock.patch.object(failover.signal, "signal") as m_sig, \
+                mock.patch.object(failover.signal,
+                                  "raise_signal") as m_raise:
+            g._on_signal(signal.SIGTERM, None)
+        m_sig.assert_called_once_with(signal.SIGTERM, signal.SIG_DFL)
+        m_raise.assert_called_once_with(signal.SIGTERM)
+        assert not g.flagged
+    finally:
+        g._installed = False
+        g._prev_handlers.clear()
+
+
+def test_uninstall_restores_handlers_exactly_once():
+    """uninstall() puts the pre-install handlers back and becomes a no-op:
+    a second uninstall must NOT clobber handlers someone registered in
+    between (double-restore would undo the newer registration)."""
+    from analytics_zoo_tpu.core import PreemptionGuard
+    h0 = lambda s, f: None  # noqa: E731
+    prev = signal.signal(signal.SIGTERM, h0)
+    try:
+        g = PreemptionGuard(sync_every=2).install()
+        assert signal.getsignal(signal.SIGTERM) == g._on_signal
+        g.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is h0  # restored
+        h1 = lambda s, f: None  # noqa: E731
+        signal.signal(signal.SIGTERM, h1)
+        g.uninstall()  # second call: must not touch handlers
+        assert signal.getsignal(signal.SIGTERM) is h1
+        # and a fresh install/uninstall cycle still works
+        g.install()
+        assert signal.getsignal(signal.SIGTERM) == g._on_signal
+        g.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is h1
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
 
 def test_signal_handler_is_lock_free():
     """Regression (round-2 advisor): the handler body must take NO lock —

@@ -1,0 +1,473 @@
+"""End-to-end resilience for serving + checkpointing (ISSUE 1 tentpole).
+
+Every scenario here is DETERMINISTIC: faults come from the seeded
+registry (core/faults.py), not from racing real failures, and no
+injected sleep exceeds 0.5 s.  The acceptance contract:
+
+- the client survives a dropped connection / server restart and a
+  "queue full" rejection via retry with backoff;
+- an expired-deadline request is shed server-side without running
+  inference;
+- a ``checkpoint.write_fail`` fault is retried and the save succeeds;
+- ``ClusterServing.stop()`` drains with every pending client receiving
+  an error reply (zero hung ``query()`` calls).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import checkpoint as ckpt_io
+from analytics_zoo_tpu.core.faults import FaultRegistry, get_registry
+from analytics_zoo_tpu.serving import ClusterServing, InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.client import RetryPolicy
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_registry():
+    get_registry().reset()
+    yield
+    get_registry().reset()
+
+
+class _CountingModel:
+    """Doubles its input; records every batch it actually ran."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.calls = []  # list of row counts per predict() call
+        self._lock = threading.Lock()
+
+    def predict(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        with self._lock:
+            self.calls.append(np.asarray(x).shape[0])
+        return np.asarray(x) * 2.0
+
+    @property
+    def rows_seen(self) -> int:
+        with self._lock:
+            return sum(self.calls)
+
+
+def _fast_retry(**kw) -> RetryPolicy:
+    kw.setdefault("max_attempts", 5)
+    kw.setdefault("base_delay", 0.02)
+    kw.setdefault("max_delay", 0.2)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_retry_policy_backoff_grows_and_caps():
+    p = RetryPolicy(base_delay=0.1, max_delay=0.5, jitter=0.0, seed=0)
+    assert p.delay(1) == pytest.approx(0.1)
+    assert p.delay(2) == pytest.approx(0.2)
+    assert p.delay(3) == pytest.approx(0.4)
+    assert p.delay(4) == pytest.approx(0.5)  # capped
+    assert p.delay(9) == pytest.approx(0.5)
+
+
+def test_retry_policy_jitter_is_seeded():
+    a = RetryPolicy(jitter=0.5, seed=3)
+    b = RetryPolicy(jitter=0.5, seed=3)
+    assert [a.delay(i) for i in range(1, 5)] == \
+           [b.delay(i) for i in range(1, 5)]
+    assert RetryPolicy(jitter=0.5, seed=4).delay(1) != a.delay(1)
+
+
+def test_retry_policy_rejects_zero_attempts():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+# -- client resilience --------------------------------------------------------
+
+def test_client_retries_queue_full_rejection():
+    """The first two pushes are rejected ("queue full"); the client's
+    bounded retry re-enqueues the SAME uuid and the request succeeds."""
+    model = _CountingModel()
+    faults = get_registry()
+    with ClusterServing(model, batch_size=2) as srv:
+        faults.enable("serving.queue_reject", times=2)
+        iq = InputQueue(srv.host, srv.port, retry=_fast_retry())
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid = iq.enqueue("t", t=x)
+        out = oq.query(uid, timeout=20.0)
+        assert out is not None
+        np.testing.assert_allclose(out, x * 2.0)
+        assert faults.fired("serving.queue_reject") == 2
+        assert iq.conn.stats["resends"] >= 2
+        assert srv.stats()["rejected"] == 2
+        iq.close()
+
+
+def test_queue_full_raises_when_retries_exhausted():
+    """A persistently full queue surfaces as an error, not a hang."""
+    faults = get_registry()
+    with ClusterServing(_CountingModel(), batch_size=2) as srv:
+        faults.enable("serving.queue_reject")  # unlimited
+        iq = InputQueue(srv.host, srv.port,
+                        retry=_fast_retry(max_attempts=3))
+        oq = OutputQueue(input_queue=iq)
+        uid = iq.enqueue("t", t=np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="queue full"):
+            oq.query(uid, timeout=20.0)
+        iq.close()
+
+
+def test_client_survives_injected_connection_drop():
+    """``serving.conn_drop``: the server hangs up mid-request without a
+    reply.  The client notices the dead reader, reconnects with backoff,
+    re-enqueues the same uuid, and the retry lands normally."""
+    model = _CountingModel()
+    faults = get_registry()
+    with ClusterServing(model, batch_size=2) as srv:
+        faults.enable("serving.conn_drop", times=1)
+        iq = InputQueue(srv.host, srv.port, retry=_fast_retry())
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid = iq.enqueue("t", t=x)
+        out = oq.query(uid, timeout=20.0)
+        assert out is not None
+        np.testing.assert_allclose(out, x * 2.0)
+        assert faults.fired("serving.conn_drop") == 1
+        assert iq.conn.stats["reconnects"] >= 1
+        assert iq.conn.stats["resends"] >= 1
+        iq.close()
+
+
+def test_client_survives_server_restart():
+    """Stop the server, restart it on the same port, and the SAME client
+    object's next query succeeds via reconnect + idempotent re-enqueue."""
+    model = _CountingModel()
+    srv = ClusterServing(model, batch_size=2).start()
+    port = srv.port
+    iq = InputQueue(srv.host, port,
+                    retry=_fast_retry(max_attempts=8, max_delay=0.3))
+    oq = OutputQueue(input_queue=iq)
+    try:
+        x = np.arange(4, dtype=np.float32)
+        uid = iq.enqueue("a", t=x)
+        assert oq.query(uid, timeout=20.0) is not None
+
+        srv.stop()
+        deadline = time.monotonic() + 10
+        while True:  # wait for the OS to release the port
+            try:
+                srv = ClusterServing(model, port=port,
+                                     batch_size=2).start()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+        uid2 = iq.enqueue("b", t=x)  # reconnects inside send if needed
+        out = oq.query(uid2, timeout=20.0)
+        assert out is not None
+        np.testing.assert_allclose(out, x * 2.0)
+        assert iq.conn.stats["reconnects"] >= 1
+    finally:
+        iq.close()
+        srv.stop()
+
+
+# -- deadline shedding --------------------------------------------------------
+
+def test_expired_deadline_is_shed_without_inference():
+    """While the batcher is busy (injected model latency), a request whose
+    deadline lapses in the queue is shed: the client gets an explicit
+    "deadline exceeded" error and the model NEVER sees its rows."""
+    model = _CountingModel()
+    faults = get_registry()
+    with ClusterServing(model, batch_size=1, batch_timeout_ms=1) as srv:
+        # first batch takes ~0.4s: one latency charge, consumed by req A
+        faults.enable("serving.model_latency", times=1, delay=0.4)
+        iq = InputQueue(srv.host, srv.port, retry=_fast_retry())
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid_a = iq.enqueue("a", t=x)           # occupies the batcher
+        time.sleep(0.05)                       # A reaches the model first
+        uid_b = iq.enqueue("b", deadline=0.05, t=x)  # expires in queue
+        with pytest.raises(RuntimeError, match="deadline exceeded"):
+            oq.query(uid_b, timeout=20.0)
+        assert oq.query(uid_a, timeout=20.0) is not None
+        assert model.rows_seen == 1  # B never ran inference
+        assert srv.stats()["shed"] == 1
+        iq.close()
+
+
+def test_generous_deadline_is_served_normally():
+    model = _CountingModel()
+    with ClusterServing(model, batch_size=2) as srv:
+        iq = InputQueue(srv.host, srv.port, retry=_fast_retry())
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid = iq.enqueue("t", deadline=10.0, t=x)
+        out = oq.query(uid, timeout=20.0)
+        np.testing.assert_allclose(out, x * 2.0)
+        assert srv.stats()["shed"] == 0
+        iq.close()
+
+
+# -- graceful drain -----------------------------------------------------------
+
+def test_stop_drains_pending_requests_with_error_replies():
+    """stop() on a busy server: every request still waiting in the queue
+    gets a "server shutting down" reply — zero hung query() calls."""
+    model = _CountingModel(delay=0.3)
+    srv = ClusterServing(model, batch_size=1, batch_timeout_ms=1).start()
+    # no retries: the drain reply itself must reach every client
+    iq = InputQueue(srv.host, srv.port,
+                    retry=_fast_retry(max_attempts=1))
+    oq = OutputQueue(input_queue=iq)
+    x = np.arange(4, dtype=np.float32)
+    uids = [iq.enqueue(f"r{i}", t=x) for i in range(4)]
+    time.sleep(0.1)  # first request reaches the model (0.3s of latency)
+
+    outcomes = {}
+
+    def drain_query(uid):
+        try:
+            outcomes[uid] = ("ok", oq.query(uid, timeout=15.0))
+        except RuntimeError as e:
+            outcomes[uid] = ("error", str(e))
+
+    threads = [threading.Thread(target=drain_query, args=(u,))
+               for u in uids]
+    for t in threads:
+        t.start()
+    srv.stop()
+    for t in threads:
+        t.join(timeout=20)
+    assert not any(t.is_alive() for t in threads), "hung query() calls"
+    assert len(outcomes) == 4
+    served = [u for u, (kind, _) in outcomes.items() if kind == "ok"]
+    drained = [u for u, (kind, msg) in outcomes.items()
+               if kind == "error" and "server shutting down" in msg]
+    # every request either completed before the drain or got the explicit
+    # shutdown error — nothing timed out, nothing hung
+    assert len(served) + len(drained) == 4, outcomes
+    assert len(drained) >= 1  # stop() really cut work short
+    assert srv.stats()["drained"] == len(drained)
+    iq.close()
+
+
+def test_stop_is_idempotent():
+    srv = ClusterServing(_CountingModel(), batch_size=2).start()
+    srv.stop()
+    srv.stop()  # second call must be a no-op, not an error
+
+
+def test_stop_joins_worker_threads():
+    srv = ClusterServing(_CountingModel(), batch_size=2).start()
+    workers = list(srv._threads)
+    assert all(t.is_alive() for t in workers)
+    srv.stop()
+    assert all(not t.is_alive() for t in workers)
+
+
+# -- checkpoint write retry ---------------------------------------------------
+
+def test_checkpoint_write_fail_is_retried_and_save_succeeds(tmp_path):
+    faults = get_registry()
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "step": 7}
+    faults.enable("checkpoint.write_fail", times=2, exc=OSError,
+                  message="transient fs blip")
+    path = ckpt_io.save(str(tmp_path / "ckpt"), tree, retries=3,
+                        retry_delay=0.01)
+    assert faults.fired("checkpoint.write_fail") == 2
+    restored = ckpt_io.restore(path)
+    np.testing.assert_allclose(restored["w"], tree["w"])
+    assert restored["step"] == 7
+
+
+def test_checkpoint_write_fail_exhausts_retries(tmp_path):
+    faults = get_registry()
+    faults.enable("checkpoint.write_fail", exc=OSError,
+                  message="fs is gone")  # unlimited
+    with pytest.raises(OSError, match="fs is gone"):
+        ckpt_io.save(str(tmp_path / "ckpt"), {"w": np.ones(3)},
+                     retries=3, retry_delay=0.01)
+    assert faults.fired("checkpoint.write_fail") == 3
+
+
+def test_checkpoint_retry_preserves_previous_generation(tmp_path):
+    """A save that fails every retry must leave the previous checkpoint
+    fully readable (crash-consistency holds through the retry path)."""
+    faults = get_registry()
+    path = str(tmp_path / "ckpt")
+    ckpt_io.save(path, {"w": np.zeros(3, np.float32)}, step=1)
+    faults.enable("checkpoint.write_fail", exc=OSError)
+    with pytest.raises(OSError):
+        ckpt_io.save(path, {"w": np.ones(3, np.float32)}, step=2,
+                     retries=2, retry_delay=0.01)
+    faults.reset()
+    restored = ckpt_io.restore(path)
+    np.testing.assert_allclose(restored["w"], np.zeros(3))
+    assert ckpt_io.latest_step(path) == 1
+
+
+def test_estimator_save_retries_transient_write_failure(tmp_path):
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(2)]), loss="mse",
+                               model_dir=str(tmp_path / "m"),
+                               checkpoint_retries=4)
+    x = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+    est.fit((x, y), epochs=1, batch_size=8, verbose=False)
+    faults = get_registry()
+    faults.enable("checkpoint.write_fail", times=2, exc=OSError)
+    path = est.save()
+    assert faults.fired("checkpoint.write_fail") == 2
+    assert ckpt_io.exists(path)
+
+
+def test_checkpoint_config_armed_fault_takes_retry_path(tmp_path):
+    """A fault armed WITHOUT an explicit exc (the ZooConfig.faults shape)
+    must still raise the call site's default OSError and be retried —
+    not escape the retry loop as a RuntimeError."""
+    faults = get_registry()
+    faults.configure({"checkpoint.write_fail": {"times": 1}})
+    path = ckpt_io.save(str(tmp_path / "ckpt"),
+                        {"w": np.ones(3, np.float32)}, retries=2,
+                        retry_delay=0.01)
+    assert faults.fired("checkpoint.write_fail") == 1
+    np.testing.assert_allclose(ckpt_io.restore(path)["w"], np.ones(3))
+
+
+def test_concurrent_queries_all_recover_from_one_conn_drop():
+    """Two threads share one connection with two requests in flight when
+    the server drops it.  Reconnect must replay EVERY recorded in-flight
+    frame — not only the one belonging to the thread that noticed the
+    dead reader — so neither query times out."""
+    model = _CountingModel(delay=0.3)
+    faults = get_registry()
+    with ClusterServing(model, batch_size=1, batch_timeout_ms=1) as srv:
+        iq = InputQueue(srv.host, srv.port, retry=_fast_retry())
+        oq = OutputQueue(input_queue=iq)
+        x = np.arange(4, dtype=np.float32)
+        uid_a = iq.enqueue("a", t=x)   # batcher busy with this one
+        uid_b = iq.enqueue("b", t=x)   # waiting in the queue
+        faults.enable("serving.conn_drop", times=1)
+        iq.enqueue("c", t=x)           # this frame triggers the drop
+
+        results = {}
+
+        def q(uid):
+            results[uid] = oq.query(uid, timeout=15.0)
+
+        threads = [threading.Thread(target=q, args=(u,))
+                   for u in (uid_a, uid_b)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert not any(t.is_alive() for t in threads)
+        assert results[uid_a] is not None and results[uid_b] is not None
+        np.testing.assert_allclose(results[uid_a], x * 2.0)
+        np.testing.assert_allclose(results[uid_b], x * 2.0)
+        iq.close()
+
+
+def test_query_backoff_respects_deadline():
+    """A retryable 'queue full' reply near the timeout must not let the
+    backoff sleep blow past the caller's budget."""
+    faults = get_registry()
+    with ClusterServing(_CountingModel(), batch_size=2) as srv:
+        faults.enable("serving.queue_reject")  # reject everything
+        iq = InputQueue(srv.host, srv.port,
+                        retry=RetryPolicy(max_attempts=10, base_delay=0.5,
+                                          max_delay=5.0, jitter=0.0,
+                                          seed=0))
+        oq = OutputQueue(input_queue=iq)
+        uid = iq.enqueue("t", t=np.ones(4, np.float32))
+        t0 = time.monotonic()
+        out = oq.query(uid, timeout=0.6)
+        elapsed = time.monotonic() - t0
+        assert out is None                 # budget spent, not an answer
+        assert elapsed < 2.0, elapsed      # no 5s backoff past the budget
+        iq.close()
+
+
+# -- per-server registry isolation --------------------------------------------
+
+def test_server_accepts_private_registry():
+    """A server can be given its own registry, so one test's faults never
+    leak into another server in the same process."""
+    private = FaultRegistry()
+    private.enable("serving.queue_reject")  # reject EVERYTHING on this srv
+    model = _CountingModel()
+    with ClusterServing(model, batch_size=2, faults=private) as srv_f, \
+            ClusterServing(model, batch_size=2) as srv_ok:
+        iq_f = InputQueue(srv_f.host, srv_f.port,
+                          retry=_fast_retry(max_attempts=2))
+        oq_f = OutputQueue(input_queue=iq_f)
+        uid = iq_f.enqueue("t", t=np.ones(4, np.float32))
+        with pytest.raises(RuntimeError, match="queue full"):
+            oq_f.query(uid, timeout=10.0)
+        iq_ok = InputQueue(srv_ok.host, srv_ok.port, retry=_fast_retry())
+        oq_ok = OutputQueue(input_queue=iq_ok)
+        uid = iq_ok.enqueue("t", t=np.ones(4, np.float32))
+        assert oq_ok.query(uid, timeout=10.0) is not None  # unaffected
+        iq_f.close()
+        iq_ok.close()
+
+
+# -- HTTP frontend ------------------------------------------------------------
+
+def test_http_deadline_propagates_and_stats_surface_counters():
+    import json
+    import urllib.error
+    import urllib.request
+    from analytics_zoo_tpu.serving import HTTPFrontend
+
+    model = _CountingModel()
+    faults = get_registry()
+    with ClusterServing(model, batch_size=1, batch_timeout_ms=1) as srv:
+        with HTTPFrontend(srv.host, srv.port) as fe:
+            url = f"http://{fe.host}:{fe.port}"
+            # one normal request proves the path, then a doomed one
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"instances": [[1, 2, 3, 4]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.load(r)["predictions"] == [[2, 4, 6, 8]]
+
+            # batcher busy for 0.4s; this request's 50ms budget expires
+            # in the queue -> server sheds it -> frontend answers 504
+            faults.enable("serving.model_latency", times=1, delay=0.4)
+            blocker = threading.Thread(
+                target=lambda: urllib.request.urlopen(req, timeout=30))
+            blocker.start()
+            time.sleep(0.1)
+            doomed = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"instances": [[1, 2, 3, 4]],
+                                 "deadline_ms": 50}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(doomed, timeout=30)
+            assert ei.value.code == 504
+            assert "deadline exceeded" in json.load(ei.value)["error"]
+            blocker.join(timeout=20)
+
+            with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+                stats = json.load(r)
+            assert stats["deadline_exceeded"] == 1
+            # resilient-client counters are surfaced alongside
+            for key in ("reconnects", "resends", "retries"):
+                assert key in stats
+        assert srv.stats()["shed"] == 1

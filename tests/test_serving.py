@@ -156,10 +156,14 @@ def test_serving_error_reply_reaches_client():
 
 def test_serving_backpressure_queue_full():
     """With a 1-slot queue, a slow model, and a tiny push timeout, floods get
-    explicit 'queue full' error replies instead of silent drops."""
+    explicit 'queue full' error replies instead of silent drops.  Retries
+    are disabled so the raw server-side rejection reaches the caller
+    (the default client retries these — tests/test_robustness.py)."""
+    from analytics_zoo_tpu.serving.client import RetryPolicy
     with ClusterServing(_SlowModel(delay=0.3), batch_size=1,
                         queue_items=1, push_timeout=0.05) as srv:
-        iq = InputQueue(srv.host, srv.port)
+        iq = InputQueue(srv.host, srv.port,
+                        retry=RetryPolicy(max_attempts=1))
         oq = OutputQueue(input_queue=iq)
         uids = [iq.enqueue(f"f{i}", t=np.ones(2, np.float32))
                 for i in range(8)]
