@@ -10,8 +10,9 @@ Ray').
 
 from . import hp
 from .search import (ASHAScheduler, GridSearchEngine, RandomSearchEngine,
-                     SearchEngine, Trial)
+                     SearchEngine, StopTrial, Trial, TrialTimeout)
 from .auto_estimator import AutoEstimator
 
 __all__ = ["hp", "AutoEstimator", "SearchEngine", "RandomSearchEngine",
-           "GridSearchEngine", "ASHAScheduler", "Trial"]
+           "GridSearchEngine", "ASHAScheduler", "Trial", "StopTrial",
+           "TrialTimeout"]

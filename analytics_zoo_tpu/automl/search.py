@@ -31,6 +31,10 @@ class StopTrial(Exception):
     """Raised inside report() when the scheduler prunes the trial."""
 
 
+class TrialTimeout(Exception):
+    """A trial exceeded its wall-clock budget (``trial_timeout_s``)."""
+
+
 @dataclass
 class Trial:
     trial_id: int
@@ -38,8 +42,10 @@ class Trial:
     metric: Optional[float] = None     # best reported (per mode)
     history: List[float] = field(default_factory=list)
     status: str = "pending"            # pending | done | pruned | error
+    #                                  # | timeout
     error: Optional[str] = None
     duration_s: float = 0.0
+    retries: int = 0                   # transient-failure retries used
 
 
 class ASHAScheduler:
@@ -84,10 +90,25 @@ class SearchEngine:
 
     def __init__(self, metric_mode: str = "min",
                  scheduler: Optional[ASHAScheduler] = None,
-                 max_concurrent: int = 1, seed: int = 0):
+                 max_concurrent: int = 1, seed: int = 0,
+                 trial_timeout_s: Optional[float] = None,
+                 trial_retries: int = 0):
+        """``trial_timeout_s``: per-trial wall-clock budget — a trial past
+        it is marked ``status="timeout"`` (keeping any partial metric from
+        its reports) instead of wedging the whole search.  Enforced
+        cooperatively at every ``report()`` call AND by a hard wall (the
+        trial runs on an abandonable daemon thread; a trial that never
+        reports and never returns leaks that thread — acceptable for
+        host-bound trial bodies, the only kind that wedges).
+
+        ``trial_retries``: transient trial failures (any exception) are
+        retried up to this many times before the trial is marked
+        ``error``; the count used is recorded on ``Trial.retries``."""
         self.mode = metric_mode
         self.scheduler = scheduler
         self.max_concurrent = max_concurrent
+        self.trial_timeout_s = trial_timeout_s
+        self.trial_retries = max(0, trial_retries)
         self.rng = np.random.default_rng(seed)
         self.trials: List[Trial] = []
 
@@ -103,31 +124,61 @@ class SearchEngine:
         self.trials = [Trial(i, c) for i, c in enumerate(configs)]
 
         def execute(trial: Trial) -> None:
-            t0 = time.time()
+            t0 = time.monotonic()
+            deadline = (t0 + self.trial_timeout_s
+                        if self.trial_timeout_s else None)
 
             def report(metric: float, step: int) -> None:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TrialTimeout()  # cooperative wall-clock stop
                 trial.history.append(float(metric))
-                if self.scheduler and not self.scheduler.on_report(
-                        trial, float(metric), step):
+                # retry attempts do not re-feed the shared ASHA rungs: the
+                # first attempt already contributed this trial's evidence
+                # there, and duplicate samples would skew every sibling's
+                # promotion cutoff.  (They also forgo pruning — a retried
+                # transient failure should run out its budget.)
+                if (self.scheduler and trial.retries == 0
+                        and not self.scheduler.on_report(
+                            trial, float(metric), step)):
                     raise StopTrial()
 
-            try:
-                trial.status = "running"
-                out = trial_fn(dict(trial.config), report)
-                metric = out["metric"] if isinstance(out, dict) else out
-                trial.metric = float(metric)
-                trial.status = "done"
-            except StopTrial:
-                trial.status = "pruned"
+            def partial_metric() -> None:
                 if trial.history:
                     trial.metric = (min(trial.history) if self.mode == "min"
                                     else max(trial.history))
-            except Exception as e:  # noqa: BLE001 — a trial may fail freely
-                trial.status = "error"
-                trial.error = f"{type(e).__name__}: {e}"
-                logger.warning("trial %d failed: %s", trial.trial_id,
-                               trial.error)
-            trial.duration_s = time.time() - t0
+
+            trial.status = "running"
+            while True:
+                trial.history.clear()  # fresh attempt, fresh reports
+                try:
+                    out = _call_with_deadline(
+                        trial_fn, (dict(trial.config), report), deadline)
+                    metric = out["metric"] if isinstance(out, dict) else out
+                    trial.metric = float(metric)
+                    trial.status = "done"
+                    trial.error = None  # a retried failure that healed
+                except StopTrial:
+                    trial.status = "pruned"
+                    partial_metric()
+                except TrialTimeout:
+                    trial.status = "timeout"
+                    partial_metric()  # partial evidence is still evidence
+                    logger.warning("trial %d timed out after %.1fs",
+                                   trial.trial_id, self.trial_timeout_s)
+                except Exception as e:  # noqa: BLE001 — trials fail freely
+                    trial.error = f"{type(e).__name__}: {e}"
+                    if trial.retries < self.trial_retries:
+                        trial.retries += 1
+                        logger.warning(
+                            "trial %d failed transiently (%s); retry %d/%d",
+                            trial.trial_id, trial.error, trial.retries,
+                            self.trial_retries)
+                        continue
+                    trial.status = "error"
+                    logger.warning("trial %d failed: %s", trial.trial_id,
+                                   trial.error)
+                break
+            trial.duration_s = time.monotonic() - t0
 
         if self.max_concurrent > 1:
             with ThreadPoolExecutor(self.max_concurrent) as pool:
@@ -146,6 +197,33 @@ class SearchEngine:
         logger.info("search done: best trial %d metric=%.5f config=%s",
                     best.trial_id, best.metric, best.config)
         return best
+
+
+def _call_with_deadline(fn: Callable, args: tuple,
+                        deadline: Optional[float]) -> Any:
+    """Run ``fn(*args)`` with a hard wall clock: past ``deadline`` the
+    caller gets ``TrialTimeout`` while the work runs out its course on an
+    abandoned daemon thread (Python cannot kill a thread; the cooperative
+    ``report()`` deadline check is what actually stops well-behaved
+    trials)."""
+    if deadline is None:
+        return fn(*args)
+    box: Dict[str, Any] = {}
+
+    def run() -> None:
+        try:
+            box["out"] = fn(*args)
+        except BaseException as e:  # noqa: BLE001 — re-raised by caller
+            box["exc"] = e
+
+    th = threading.Thread(target=run, daemon=True, name="zoo-trial")
+    th.start()
+    th.join(timeout=max(0.0, deadline - time.monotonic()))
+    if th.is_alive():
+        raise TrialTimeout()
+    if "exc" in box:
+        raise box["exc"]
+    return box["out"]
 
 
 class RandomSearchEngine(SearchEngine):

@@ -1,7 +1,7 @@
 """Core runtime: context bootstrap, config, checkpointing, summaries."""
 
 from .config import MeshConfig, ZooConfig
-from .context import (OrcaContext, get_mesh, init_nncontext,
+from .context import (OrcaContext, get_mesh, heartbeat, init_nncontext,
                       init_orca_context, make_mesh, stop_orca_context)
 from . import checkpoint
 from . import faults
@@ -11,7 +11,8 @@ from .summary import SummaryWriter
 
 __all__ = [
     "MeshConfig", "ZooConfig", "OrcaContext", "get_mesh", "init_nncontext",
-    "init_orca_context", "make_mesh", "stop_orca_context", "checkpoint",
+    "init_orca_context", "make_mesh", "stop_orca_context", "heartbeat",
+    "checkpoint",
     "SummaryWriter", "Preempted", "PreemptionGuard", "faults",
     "FaultRegistry",
 ]

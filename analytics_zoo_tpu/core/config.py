@@ -105,6 +105,14 @@ class ZooConfig:
     log_dir: str = "/tmp/analytics_zoo_tpu"
     log_level: str = "INFO"
 
+    # worker liveness (core/launcher.py gang supervision): a file this
+    # process touches at init and then on training progress, so a
+    # supervisor can tell a hung worker from a slow one.  ``None`` falls
+    # back to the ZOO_HEARTBEAT_FILE / ZOO_HEARTBEAT_INTERVAL env vars the
+    # zoo-launch supervisor sets; unset both = no heartbeat.
+    heartbeat_file: Optional[str] = None
+    heartbeat_interval: Optional[float] = None
+
     # fault injection (core/faults.py): {point: enable-kwargs}, e.g.
     # {"serving.queue_reject": {"times": 3, "seed": 7}} — armed on the
     # global registry by init_orca_context.  Empty = everything disabled.

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import threading
+import time
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -24,6 +26,45 @@ import numpy as np
 from .config import MeshConfig, ZooConfig
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class _Heartbeat:
+    """Progress-based worker liveness: ``beat()`` touches the heartbeat
+    file at most once per ``interval``.  Deliberately NOT a free-running
+    daemon thread — a daemon would keep beating while the training loop is
+    wedged, which is exactly the failure the supervisor must detect.  The
+    training loop calls ``beat()`` every step; a worker whose steps stop
+    (hang, deadlock, lost collective) stops beating and the zoo-launch
+    supervisor kills and restarts the gang on heartbeat loss."""
+
+    def __init__(self, path: str, interval: float):
+        self.path = path
+        self.interval = max(0.05, float(interval))
+        self._last = 0.0
+
+    def beat(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last < self.interval:
+            return
+        self._last = now
+        try:
+            with open(self.path, "a"):
+                pass
+            os.utime(self.path, None)
+        except OSError:  # liveness reporting must never kill training
+            logger.debug("heartbeat touch failed for %s", self.path)
+
+
+_HEARTBEAT: Optional[_Heartbeat] = None
+
+
+def heartbeat() -> None:
+    """Report training progress to the gang supervisor (no-op unless a
+    heartbeat file is configured).  Called from the Estimator step loop;
+    long-running custom loops should call it too."""
+    hb = _HEARTBEAT
+    if hb is not None:
+        hb.beat()
 
 
 class _ZooContextMeta(type):
@@ -171,6 +212,20 @@ def init_orca_context(cluster_mode: str = "local",
             logger.warning("fault injection armed from config: %s",
                            sorted(cfg.faults))
 
+        # supervisor liveness contract (core/launcher.py): touch the
+        # heartbeat file now — "import + init finished" is the first beat —
+        # then let the training loop beat on progress
+        global _HEARTBEAT
+        if cfg.heartbeat_file is None:
+            cfg.heartbeat_file = os.environ.get("ZOO_HEARTBEAT_FILE")
+        if cfg.heartbeat_interval is None:
+            cfg.heartbeat_interval = float(
+                os.environ.get("ZOO_HEARTBEAT_INTERVAL", "1.0"))
+        if cfg.heartbeat_file:
+            _HEARTBEAT = _Heartbeat(cfg.heartbeat_file,
+                                    cfg.heartbeat_interval)
+            _HEARTBEAT.beat(force=True)
+
         _ZooContextMeta._mesh = make_mesh(cfg.mesh)
         _ZooContextMeta._config = cfg
         logger.info("initialized context: %d device(s), mesh %s",
@@ -186,9 +241,11 @@ def stop_orca_context() -> None:
     to kill Ray raylets and the SparkContext; here there is nothing to kill
     beyond forgetting the globals, since collectives are compiled, not
     daemonized)."""
+    global _HEARTBEAT
     with _ZooContextMeta._lock:
         _ZooContextMeta._config = None
         _ZooContextMeta._mesh = None
+        _HEARTBEAT = None
 
 
 def get_mesh() -> jax.sharding.Mesh:

@@ -16,6 +16,10 @@ Registered points (new subsystems add theirs via ``register_point``):
 - ``serving.queue_reject``   serving queue push rejected ("queue full")
 - ``checkpoint.write_fail``  transient checkpoint write failure (OSError)
 - ``feed.stall``             data feed stalls before yielding a batch
+- ``feed.read_fail``         one sample-loader read fails (streaming feed)
+- ``worker.crash``           training worker dies hard (os._exit) mid-step
+- ``worker.hang``            training worker wedges (long sleep) mid-step
+- ``step.nan``               one train batch is poisoned to non-finite
 
 Usage in a test::
 
@@ -53,6 +57,10 @@ KNOWN_POINTS = {
     "serving.queue_reject",
     "checkpoint.write_fail",
     "feed.stall",
+    "feed.read_fail",
+    "worker.crash",
+    "worker.hang",
+    "step.nan",
 }
 
 
@@ -66,20 +74,23 @@ def register_point(name: str) -> str:
 class _Spec:
     """Armed state of one injection point."""
 
-    __slots__ = ("times", "prob", "exc", "message", "delay", "rng")
+    __slots__ = ("times", "prob", "exc", "message", "delay", "after", "rng")
 
     def __init__(self, times: Optional[int], prob: float,
                  exc: Optional[Type[BaseException]], message: Optional[str],
-                 delay: float, seed: int):
+                 delay: float, after: int, seed: int):
         if times is not None and times < 1:
             raise ValueError(f"times must be >= 1 or None, got {times}")
         if not 0.0 < prob <= 1.0:
             raise ValueError(f"prob must be in (0, 1], got {prob}")
+        if after < 0:
+            raise ValueError(f"after must be >= 0, got {after}")
         self.times = times          # remaining fires; None = unlimited
         self.prob = prob
         self.exc = exc
         self.message = message
         self.delay = delay
+        self.after = after          # hits to pass through before eligibility
         self.rng = random.Random(seed)
 
 
@@ -100,17 +111,20 @@ class FaultRegistry:
     def enable(self, name: str, *, times: Optional[int] = None,
                prob: float = 1.0, exc: Optional[Type[BaseException]] = None,
                message: Optional[str] = None, delay: float = 0.0,
-               seed: int = 0) -> None:
+               after: int = 0, seed: int = 0) -> None:
         """Arm ``name``: fire on the next ``times`` matching hits (None =
         every hit), each hit firing with probability ``prob`` drawn from a
         ``seed``-ed RNG.  A firing hit sleeps ``delay`` seconds and, if
-        ``exc`` is set, raises ``exc(message)``."""
+        ``exc`` is set, raises ``exc(message)``.  ``after`` lets the first
+        ``after`` hits pass through untouched — "crash on step K" is
+        ``enable("worker.crash", times=1, after=K-1)``."""
         if name not in KNOWN_POINTS:
             raise ValueError(
                 f"unknown injection point {name!r}; known points: "
                 f"{sorted(KNOWN_POINTS)} (add new ones via register_point)")
         with self._lock:
-            self._specs[name] = _Spec(times, prob, exc, message, delay, seed)
+            self._specs[name] = _Spec(times, prob, exc, message, delay,
+                                      after, seed)
 
     def disable(self, name: str) -> None:
         with self._lock:
@@ -161,6 +175,9 @@ class FaultRegistry:
         with self._lock:
             self._hits[name] = self._hits.get(name, 0) + 1
             spec = self._specs.get(name)
+            if spec is not None and spec.after > 0:
+                spec.after -= 1
+                spec = None         # this hit passes through untouched
             if spec is not None and (spec.prob >= 1.0
                                      or spec.rng.random() < spec.prob):
                 fired = True
@@ -208,6 +225,12 @@ class FaultRegistry:
     def is_armed(self, name: str) -> bool:
         with self._lock:
             return name in self._specs
+
+    def armed_points(self) -> list:
+        """Sorted names of every currently armed point (leak checks: a test
+        that arms without the scoped helper must disarm before it ends)."""
+        with self._lock:
+            return sorted(self._specs)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         """{point: {"hits": n, "fired": m}} for every point ever reached."""

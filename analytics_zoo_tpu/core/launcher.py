@@ -1,11 +1,13 @@
-"""``zoo-launch``: the multi-process launcher.
+"""``zoo-launch``: the multi-process launcher and gang supervisor.
 
 Reference (SURVEY.md §2.1/L10): the reference shipped shell launchers
 (scripts/spark-submit-python-with-zoo.sh, jupyter/cluster-serving scripts)
 that assembled a spark-submit command line — cluster bootstrap lived
-outside the library.  On TPU the platform (GKE/QR) normally starts one
-process per host and ``jax.distributed.initialize`` auto-discovers the
-topology; this launcher covers the two cases that still need help:
+outside the library, and failure recovery leaned on the Spark/Ray
+supervisors respawning lost executors.  On TPU the platform (GKE/QR)
+normally starts one process per host and ``jax.distributed.initialize``
+auto-discovers the topology; this launcher covers the two cases that
+still need help:
 
 1. **Simulation** (the default): spawn N local processes, each a
    ``jax.distributed`` participant with its own CPU devices — the
@@ -14,22 +16,58 @@ topology; this launcher covers the two cases that still need help:
 2. **Manual clusters**: ``--process-id``/``--coordinator`` run exactly one
    process of an N-process job on this machine (one invocation per host).
 
+``launch()`` is a *supervisor*, not a waiter: it polls the whole gang
+concurrently, so the first worker death is detected within
+``poll_interval`` seconds (not after ``nprocs * timeout`` sequential
+waits), terminates the survivors promptly (SIGTERM, then SIGKILL after
+``grace`` — the SIGTERM window is exactly what ``PreemptionGuard`` needs
+to land a checkpoint), and — within a bounded restart budget with
+exponential backoff — relaunches the gang so workers auto-resume from
+their latest checkpoint.  A gang is restarted as a whole: SPMD workers
+cannot rejoin a running ``jax.distributed`` job one at a time.
+
+Hung-vs-slow workers are distinguished by **heartbeat files**: when
+``heartbeat_timeout`` is set, each worker gets a private file via
+``ZOO_HEARTBEAT_FILE`` which ``init_orca_context`` touches at startup and
+the training loop touches every ``ZOO_HEARTBEAT_INTERVAL`` seconds of
+progress.  A live-but-silent worker (mtime older than the timeout) is
+treated like a crash: the gang is killed and restarted.  A worker that is
+merely slow keeps beating and is left alone.
+
+Crash loops are diagnosed, not retried forever: if the same worker rank
+is the first failure ``crash_loop_threshold`` times, the supervisor
+aborts with that diagnosis even if restart budget remains.
+
 The script's contract with ``init_orca_context("multihost")`` is three env
 vars: ``ZOO_COORDINATOR``, ``ZOO_NUM_PROCESSES``, ``ZOO_PROCESS_ID``.
+The supervisor adds:
+
+- ``ZOO_RESTART_COUNT``       how many gang restarts preceded this run
+- ``ZOO_HEARTBEAT_FILE``      per-worker liveness file (when supervised)
+- ``ZOO_HEARTBEAT_INTERVAL``  seconds between beats (default 1.0)
 
 Usage:
   zoo-launch --nprocs 2 train.py --epochs 3          # simulate 2 hosts
   zoo-launch --nprocs 2 --devices-per-proc 4 train.py
   zoo-launch --nprocs 8 --process-id 3 --coordinator host0:1234 train.py
+  zoo-launch --nprocs 4 --max-restarts 3 --heartbeat-timeout 60 train.py
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import subprocess
 import sys
-from typing import List, Optional
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: Exit code when the supervisor aborts on a diagnosed crash loop.
+EXIT_CRASH_LOOP = 86
 
 
 def _free_port() -> int:
@@ -39,8 +77,8 @@ def _free_port() -> int:
 
 
 def _child_env(coordinator: str, nprocs: int, pid: int,
-               devices_per_proc: Optional[int], platform: Optional[str]
-               ) -> dict:
+               devices_per_proc: Optional[int], platform: Optional[str],
+               extra: Optional[Dict[str, str]] = None) -> dict:
     env = dict(os.environ)
     env["ZOO_COORDINATOR"] = coordinator
     env["ZOO_NUM_PROCESSES"] = str(nprocs)
@@ -54,32 +92,190 @@ def _child_env(coordinator: str, nprocs: int, pid: int,
         # the environment's TPU plugin hook would override JAX_PLATFORMS
         if platform == "cpu":
             env.pop("PALLAS_AXON_POOL_IPS", None)
+    if extra:
+        env.update(extra)
     return env
+
+
+def _terminate_gang(procs: List[subprocess.Popen], grace: float) -> None:
+    """SIGTERM every live worker, give them ``grace`` seconds to exit (the
+    preemption-checkpoint window), then SIGKILL stragglers and reap."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+
+
+def _supervise(procs: List[subprocess.Popen], hb_files: List[Optional[str]],
+               heartbeat_timeout: Optional[float],
+               timeout: Optional[float], poll_interval: float
+               ) -> Tuple[str, Optional[int], Optional[int]]:
+    """Poll the gang until a verdict: ("ok", None, 0), ("crash", rank, rc),
+    ("hang", rank, None), or ("timeout", None, None)."""
+    start = time.monotonic()
+    while True:
+        all_done = True
+        for rank, p in enumerate(procs):
+            rc = p.poll()
+            if rc is None:
+                all_done = False
+                hb = hb_files[rank]
+                if heartbeat_timeout is not None and hb is not None:
+                    try:
+                        stale = (time.time() - os.path.getmtime(hb)
+                                 > heartbeat_timeout)
+                    except OSError:
+                        stale = True  # file vanished: no proof of life
+                    if stale:
+                        return "hang", rank, None
+            elif rc != 0:
+                return "crash", rank, rc
+        if all_done:
+            return "ok", None, 0
+        if timeout is not None and time.monotonic() - start > timeout:
+            return "timeout", None, None
+        time.sleep(poll_interval)
 
 
 def launch(script: str, script_args: List[str], nprocs: int,
            devices_per_proc: Optional[int] = None,
            coordinator: Optional[str] = None,
            platform: Optional[str] = None,
-           timeout: Optional[float] = None) -> int:
-    """Spawn ``nprocs`` local processes running ``script``; returns the max
-    exit code.  Output is interleaved (line-buffered) like torchrun."""
-    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
-    procs = []
-    for pid in range(nprocs):
-        env = _child_env(coordinator, nprocs, pid, devices_per_proc,
-                         platform)
-        procs.append(subprocess.Popen(
-            [sys.executable, script, *script_args], env=env))
-    rcs = []
+           timeout: Optional[float] = None,
+           max_restarts: int = 0,
+           backoff: float = 0.5,
+           backoff_factor: float = 2.0,
+           max_backoff: float = 30.0,
+           heartbeat_timeout: Optional[float] = None,
+           heartbeat_interval: float = 1.0,
+           heartbeat_dir: Optional[str] = None,
+           grace: float = 5.0,
+           poll_interval: float = 0.05,
+           crash_loop_threshold: int = 3,
+           on_event: Optional[Callable[[str, dict], None]] = None) -> int:
+    """Run a gang of ``nprocs`` local processes under supervision.
+
+    Returns 0 when (an attempt of) the gang finishes cleanly.  On the
+    first worker crash (nonzero exit) or heartbeat loss the surviving
+    workers are terminated and, while ``max_restarts`` budget remains, the
+    whole gang is relaunched after an exponential backoff
+    (``backoff * backoff_factor**attempt``, capped at ``max_backoff``) —
+    workers resume from their checkpoints via ``auto_resume``.  When the
+    budget is exhausted the failing worker's exit code is returned; a
+    diagnosed crash loop (the same rank first-failing
+    ``crash_loop_threshold`` times) aborts early with ``EXIT_CRASH_LOOP``.
+
+    ``timeout`` bounds one attempt's wall clock; exceeding it kills the
+    gang and raises ``subprocess.TimeoutExpired`` (the pre-supervisor
+    contract).  ``on_event(kind, info)`` observes supervisor decisions
+    ("crash"/"hang"/"restart"/"crash_loop"/"ok") — tests assert on it.
+    """
+    emit = on_event or (lambda kind, info: None)
+    hb_dir = heartbeat_dir
+    own_hb_dir = heartbeat_timeout is not None and hb_dir is None
+    if own_hb_dir:
+        hb_dir = tempfile.mkdtemp(prefix="zoo_hb_")
     try:
-        for p in procs:
-            rcs.append(p.wait(timeout=timeout))
+        return _launch_supervised(
+            script, script_args, nprocs, devices_per_proc, coordinator,
+            platform, timeout, max_restarts, backoff, backoff_factor,
+            max_backoff, heartbeat_timeout, heartbeat_interval, hb_dir,
+            grace, poll_interval, crash_loop_threshold, emit)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return max(rcs) if rcs else 1
+        if own_hb_dir:
+            import shutil
+            shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+def _launch_supervised(script, script_args, nprocs, devices_per_proc,
+                       coordinator, platform, timeout, max_restarts,
+                       backoff, backoff_factor, max_backoff,
+                       heartbeat_timeout, heartbeat_interval, hb_dir,
+                       grace, poll_interval, crash_loop_threshold,
+                       emit) -> int:
+    attempt = 0
+    first_fail_counts: Dict[int, int] = {}
+    while True:
+        coord = coordinator or f"127.0.0.1:{_free_port()}"
+        procs: List[subprocess.Popen] = []
+        hb_files: List[Optional[str]] = []
+        try:
+            # spawning INSIDE the try: a mid-loop Popen failure (fork
+            # EAGAIN, full hb filesystem) must not orphan the ranks
+            # already started — they'd block in jax.distributed.initialize
+            # forever waiting for the missing gang members
+            for pid in range(nprocs):
+                extra = {"ZOO_RESTART_COUNT": str(attempt)}
+                hb: Optional[str] = None
+                if hb_dir is not None:
+                    hb = os.path.join(hb_dir, f"hb_a{attempt}_w{pid}")
+                    # baseline touch: the worker owns it from
+                    # init_orca_context on, but import time must not read
+                    # as a hang
+                    with open(hb, "a"):
+                        os.utime(hb, None)
+                    extra["ZOO_HEARTBEAT_FILE"] = hb
+                    extra["ZOO_HEARTBEAT_INTERVAL"] = str(
+                        heartbeat_interval)
+                hb_files.append(hb)
+                env = _child_env(coord, nprocs, pid, devices_per_proc,
+                                 platform, extra)
+                procs.append(subprocess.Popen(
+                    [sys.executable, script, *script_args], env=env))
+            verdict, rank, rc = _supervise(procs, hb_files,
+                                           heartbeat_timeout, timeout,
+                                           poll_interval)
+        finally:
+            _terminate_gang(procs, grace)
+        if verdict == "ok":
+            emit("ok", {"attempt": attempt})
+            return 0
+        if verdict == "timeout":
+            raise subprocess.TimeoutExpired(script, timeout)  # type: ignore[arg-type]
+        # crash or hang: ``rank`` is the first-detected culprit
+        emit(verdict, {"attempt": attempt, "rank": rank, "rc": rc})
+        logger.warning("gang attempt %d: worker %d %s (rc=%s); "
+                       "terminated the gang", attempt, rank,
+                       "crashed" if verdict == "crash" else
+                       "lost its heartbeat", rc)
+        fail_rc = rc if (rc is not None and rc > 0) else 1
+        first_fail_counts[rank] = first_fail_counts.get(rank, 0) + 1
+        if first_fail_counts[rank] >= crash_loop_threshold:
+            emit("crash_loop", {"rank": rank,
+                                "count": first_fail_counts[rank]})
+            logger.error(
+                "crash loop: worker %d was the first failure in %d of %d "
+                "attempts — aborting instead of restarting (fix the worker; "
+                "restarts cannot outrun a deterministic fault)",
+                rank, first_fail_counts[rank], attempt + 1)
+            return EXIT_CRASH_LOOP
+        if attempt >= max_restarts:
+            logger.error("restart budget exhausted after %d attempt(s); "
+                         "giving up with rc=%d", attempt + 1, fail_rc)
+            return fail_rc
+        delay = min(backoff * (backoff_factor ** attempt), max_backoff)
+        emit("restart", {"attempt": attempt + 1, "delay": delay})
+        logger.warning("relaunching the gang in %.2fs "
+                       "(restart %d of %d)", delay, attempt + 1,
+                       max_restarts)
+        time.sleep(delay)
+        attempt += 1
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -100,6 +296,24 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "host on a real cluster)")
     parser.add_argument("--platform", default=None,
                         help="force a jax platform (e.g. cpu for simulation)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="wall-clock bound for one gang attempt (s)")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="gang restarts allowed after a worker crash or "
+                             "heartbeat loss (workers auto-resume from "
+                             "checkpoints)")
+    parser.add_argument("--restart-backoff", type=float, default=0.5,
+                        help="base exponential-backoff delay between "
+                             "restarts (s)")
+    parser.add_argument("--heartbeat-timeout", type=float, default=None,
+                        help="kill-and-restart a worker whose heartbeat "
+                             "file goes stale for this many seconds "
+                             "(default: heartbeats off)")
+    parser.add_argument("--heartbeat-interval", type=float, default=1.0,
+                        help="seconds between worker heartbeats")
+    parser.add_argument("--crash-loop-threshold", type=int, default=3,
+                        help="abort (exit %d) when the same worker first-"
+                             "fails this many times" % EXIT_CRASH_LOOP)
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -111,9 +325,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                          args.devices_per_proc, args.platform)
         os.execve(sys.executable,
                   [sys.executable, args.script, *args.script_args], env)
-    raise SystemExit(launch(args.script, args.script_args, args.nprocs,
-                            args.devices_per_proc, args.coordinator,
-                            args.platform))
+    raise SystemExit(launch(
+        args.script, args.script_args, args.nprocs,
+        args.devices_per_proc, args.coordinator, args.platform,
+        timeout=args.timeout, max_restarts=args.max_restarts,
+        backoff=args.restart_backoff,
+        heartbeat_timeout=args.heartbeat_timeout,
+        heartbeat_interval=args.heartbeat_interval,
+        crash_loop_threshold=args.crash_loop_threshold))
 
 
 if __name__ == "__main__":

@@ -17,6 +17,15 @@ of worker timing (predict depends on row order; training gets reproducible
 batch sequences), and double-buffers device placement so the host→HBM copy
 of batch N+1 overlaps compute of batch N.
 
+Loader resilience: at ImageNet scale a corrupt JPEG or a flaky filesystem
+read is routine, and a single exception must not cost an epoch.  Each
+sample read gets ``retries`` bounded retries; after that,
+``on_error="skip"`` substitutes a neighboring sample and counts the loss
+(``skipped_rows``/``load_failures`` make the degradation visible, and
+``max_skipped`` bounds it), while the default ``on_error="raise"``
+propagates the failure to the consumer.  The ``feed.read_fail`` injection
+point (core/faults.py) makes both paths deterministically testable.
+
 Same interface as DataFeed (both subclass feed.FeedBase), so Estimator.fit
 takes either interchangeably.
 """
@@ -34,27 +43,107 @@ from .feed import FeedBase, shard_batch
 
 _ERROR_TOKEN = (1 << 63) - 1
 
+#: How many alternative indices a skipped sample may be substituted with
+#: before the failure is treated as systemic and re-raised.
+_MAX_FALLBACK_TRIES = 8
+
 
 class StreamingDataFeed(FeedBase):
-    """Index-based streaming loader: ``load_sample(i, rng)`` → sample dict."""
+    """Index-based streaming loader: ``load_sample(i, rng)`` → sample dict.
+
+    ``retries``: per-sample reload attempts after a loader exception
+    (0 = fail on first exception).  ``on_error``: what to do once retries
+    are exhausted — ``"raise"`` (default) aborts the epoch with the
+    loader's exception; ``"skip"`` substitutes the next loadable sample
+    index and increments ``skipped_rows``.  ``max_skipped`` (with
+    ``"skip"``) bounds silent degradation: exceeding it raises."""
 
     def __init__(self, num_samples: int,
                  load_sample: Callable[..., Dict[str, np.ndarray]],
                  batch_size: int, shuffle: bool = True, seed: int = 0,
                  num_workers: int = 4, prefetch_batches: int = 4,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True,
+                 retries: int = 0, on_error: str = "raise",
+                 max_skipped: Optional[int] = None):
         super().__init__(num_samples, batch_size, shuffle, seed,
                          drop_remainder)
+        if on_error not in ("raise", "skip"):
+            raise ValueError(f"on_error must be 'raise' or 'skip', "
+                             f"got {on_error!r}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
         self._load = load_sample
         self.num_workers = max(1, num_workers)
         self.prefetch_batches = max(1, prefetch_batches)
+        self.retries = retries
+        self.on_error = on_error
+        self.max_skipped = max_skipped
+        self._counter_lock = threading.Lock()
+        self.skipped_rows = 0    # rows substituted because their sample
+        #                          never loaded (on_error="skip")
+        self.load_failures = 0   # loader exceptions seen (incl. retried)
+
+    # -- resilient sample loading --------------------------------------------
+
+    def _fault_registry(self):
+        from analytics_zoo_tpu.core import faults
+        return faults.get_registry()
+
+    def _load_with_retry(self, i: int, rng,
+                         inject: bool = True) -> Dict[str, np.ndarray]:
+        """One sample through the loader with ``retries`` bounded retries.
+        The ``feed.read_fail`` injection point sits INSIDE the attempt so
+        an armed fault exercises the same except-clause a real corrupt
+        read would — and is retried the same way.  ``inject=False`` for
+        fallback substitution loads, so a fault armed against the primary
+        sample cannot cascade into every substitute."""
+        last: Optional[BaseException] = None
+        for _attempt in range(self.retries + 1):
+            try:
+                if inject:
+                    self._fault_registry().raise_if("feed.read_fail",
+                                                    OSError)
+                return self._load(i, rng=rng)
+            except Exception as e:  # noqa: BLE001 — loader bugs vary freely
+                last = e
+                with self._counter_lock:
+                    self.load_failures += 1
+        assert last is not None
+        raise last
+
+    def _load_row(self, i: int, rng) -> Dict[str, np.ndarray]:
+        """Sample ``i`` with retry + optional skip-and-substitute."""
+        try:
+            return self._load_with_retry(i, rng)
+        except Exception:
+            if self.on_error != "skip":
+                raise
+            with self._counter_lock:
+                self.skipped_rows += 1
+                skipped = self.skipped_rows
+            if self.max_skipped is not None and skipped > self.max_skipped:
+                raise RuntimeError(
+                    f"streaming feed skipped {skipped} rows "
+                    f"(max_skipped={self.max_skipped}): loader failures "
+                    "are no longer a tolerable minority") from None
+            # substitute neighboring samples (no injection hits, plain
+            # retries only) so the batch keeps its static shape
+            for k in range(1, _MAX_FALLBACK_TRIES + 1):
+                alt = (i + k) % self._n
+                try:
+                    return self._load_with_retry(alt, rng, inject=False)
+                except Exception:
+                    continue
+            raise RuntimeError(
+                f"sample {i} and {_MAX_FALLBACK_TRIES} fallback samples all "
+                "failed to load: the failure is systemic, not per-sample")
 
     def remainder(self) -> Optional[Dict[str, np.ndarray]]:
         r = self._n % self._local_batch
         if r == 0:
             return None
         rng = np.random.default_rng(self.seed)
-        rows = [self._load(i, rng=rng) for i in range(self._n - r, self._n)]
+        rows = [self._load_row(i, rng) for i in range(self._n - r, self._n)]
         return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
 
     def dropped_rows(self, epoch_idx: int = 0):
@@ -65,7 +154,7 @@ class StreamingDataFeed(FeedBase):
             return None
         sel = self._epoch_index(epoch_idx)[self._n - r:]
         rng = np.random.default_rng(self.seed)
-        rows = [self._load(int(i), rng=rng) for i in sel]
+        rows = [self._load_row(int(i), rng) for i in sel]
         return {k: np.stack([row[k] for row in rows]) for k in rows[0]}
 
     def epoch(self, mesh: Mesh, epoch_idx: int = 0, place: bool = True
@@ -82,6 +171,9 @@ class StreamingDataFeed(FeedBase):
         queue = NativeQueue(max_items=self.prefetch_batches)
         ready: Dict[int, Dict[str, np.ndarray]] = {}
         ready_lock = threading.Lock()
+        # one condition guards BOTH ready and errors: workers notify when
+        # either changes, so the consumer never busy-waits
+        ready_cond = threading.Condition(ready_lock)
         step_iter = iter(range(steps))
         step_lock = threading.Lock()
         errors: List[BaseException] = []
@@ -96,18 +188,21 @@ class StreamingDataFeed(FeedBase):
                     return
                 sel = self._batch_index(idx, step)
                 try:
-                    rows = [self._load(int(i), rng=rng) for i in sel]
+                    rows = [self._load_row(int(i), rng) for i in sel]
                     batch = {k: np.stack([r[k] for r in rows])
                              for k in rows[0]}
                 except BaseException as e:          # noqa: BLE001 loader bug
-                    errors.append(e)
+                    with ready_cond:
+                        errors.append(e)
+                        ready_cond.notify_all()
                     try:
                         queue.push(_ERROR_TOKEN.to_bytes(8, "big"))
                     except RuntimeError:
                         pass                        # consumer already gone
                     return
-                with ready_lock:
+                with ready_cond:
                     ready[step] = batch
+                    ready_cond.notify_all()
                 try:
                     queue.push(step.to_bytes(8, "big"))  # blocks when full
                 except RuntimeError:                # queue closed: abandon
@@ -128,24 +223,26 @@ class StreamingDataFeed(FeedBase):
             draining tokens — workers then block on the full queue, halting
             production while the straggler decode finishes (workers insert
             into ``ready`` BEFORE their token push, so the straggler's
-            batch still lands)."""
-            import time as _time
+            batch still lands).  While over the bound the consumer parks on
+            the condition (woken by the next insert/error) instead of
+            spinning a sleep loop."""
             while True:
-                with ready_lock:
+                with ready_cond:
                     if expected_step in ready:
                         return ready.pop(expected_step)
-                    oversized = len(ready) >= bound
-                if errors:
-                    raise errors[0]
-                if oversized:
-                    _time.sleep(0.005)
-                    continue
+                    if errors:
+                        raise errors[0]
+                    if len(ready) >= bound:
+                        ready_cond.wait(timeout=0.2)
+                        continue
                 item = queue.pop(timeout=0.2)
                 if item is None:
                     continue                        # wait out slow decodes
                 if int.from_bytes(item[0], "big") == _ERROR_TOKEN:
-                    raise (errors[0] if errors else
-                           RuntimeError("worker aborted"))
+                    with ready_cond:
+                        err = errors[0] if errors else None
+                    raise err if err is not None else \
+                        RuntimeError("worker aborted")
 
         try:
             pending = None

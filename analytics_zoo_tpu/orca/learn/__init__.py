@@ -1,9 +1,9 @@
 """Orca learn: the unified Estimator layer (reference L6, SURVEY.md §2.4)."""
 
-from .estimator import Estimator, ZooEstimator
+from .estimator import Estimator, NonFiniteLossError, ZooEstimator
 from .gan import GANEstimator
 from .trigger import EveryEpoch, SeveralIteration, Trigger
 from . import optimizers
 
-__all__ = ["Estimator", "ZooEstimator", "EveryEpoch", "SeveralIteration",
-           "Trigger", "optimizers"]
+__all__ = ["Estimator", "ZooEstimator", "NonFiniteLossError", "EveryEpoch",
+           "SeveralIteration", "Trigger", "optimizers"]

@@ -22,6 +22,8 @@ get_model``, with TensorBoard-style summaries and checkpoint triggers.
 from __future__ import annotations
 
 import logging
+import math
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -34,6 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from analytics_zoo_tpu.core import checkpoint as ckpt_io
 from analytics_zoo_tpu.core import get_mesh
+from analytics_zoo_tpu.core import faults as faults_lib
+from analytics_zoo_tpu.core.context import heartbeat
 from analytics_zoo_tpu.core.summary import SummaryWriter
 from analytics_zoo_tpu.data import as_feed, batch_sharding, shard_batch
 from analytics_zoo_tpu.nn import losses as losses_lib
@@ -43,6 +47,19 @@ from . import optimizers as opt_lib
 from .trigger import Trigger
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+#: Valid values for ``ZooEstimator(nan_policy=...)``.
+NAN_POLICIES = ("warn", "skip_step", "rollback", "raise")
+
+
+class NonFiniteLossError(RuntimeError):
+    """A training step produced a non-finite loss and the configured
+    ``nan_policy`` could not (or was told not to) heal it."""
+
+    def __init__(self, step: int, message: Optional[str] = None):
+        super().__init__(message
+                         or f"non-finite loss at train step {step}")
+        self.step = step
 
 
 class Estimator:
@@ -139,7 +156,9 @@ class ZooEstimator:
                  preemption_sync_every: int = 10,
                  frozen: Any = None,
                  grad_accum: int = 1,
-                 checkpoint_retries: int = 3):
+                 checkpoint_retries: int = 3,
+                 nan_policy: Optional[str] = None,
+                 nan_max_rollbacks: int = 3):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -164,7 +183,28 @@ class ZooEstimator:
         full-batch step.  On bandwidth-bound models it amortizes the
         optimizer's full f32 parameter/moment sweep — profiled at ~26% of
         a BERT-base step — over ``grad_accum`` micro-batches, and keeps
-        each micro-batch at its best-fusing size."""
+        each micro-batch at its best-fusing size.
+
+        ``nan_policy``: training-loop self-healing for non-finite loss /
+        gradients (None = unguarded, zero overhead):
+
+        - ``"skip_step"``: the guard compiles INTO the train step — if the
+          loss or gradient norm is non-finite, params/state/optimizer stay
+          at their pre-step values (only ``step`` advances) and the
+          on-device ``bad_steps`` counter increments.  No per-step host
+          sync; the counter is read once per epoch.
+        - ``"warn"``: log and count the bad step, keep training (the step
+          HAS been applied — use this for visibility only).
+        - ``"rollback"``: restore the latest ``model_dir`` checkpoint and
+          continue from it; at most ``nan_max_rollbacks`` times, then
+          raises.  Requires ``model_dir`` and a checkpoint trigger (or
+          preemption checkpoints) so there is something to roll back to.
+        - ``"raise"``: raise ``NonFiniteLossError`` immediately.
+
+        ``warn``/``rollback``/``raise`` read the loss on the host every
+        step (one device sync per step); ``skip_step`` does not.  Bad-step
+        counts surface as ``history["bad_steps"]`` (per epoch), the
+        ``bad_steps`` summary scalar, and ``est.bad_steps`` (total)."""
         self.model = model
         self.loss_fn = losses_lib.get(loss)
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
@@ -182,6 +222,13 @@ class ZooEstimator:
         # are retried with backoff before a save gives up — critical for
         # the preemption window, where there is no second chance
         self.checkpoint_retries = max(1, checkpoint_retries)
+        if nan_policy is not None and nan_policy not in NAN_POLICIES:
+            raise ValueError(f"nan_policy must be one of {NAN_POLICIES} "
+                             f"or None, got {nan_policy!r}")
+        self.nan_policy = nan_policy
+        self.nan_max_rollbacks = max(0, nan_max_rollbacks)
+        self.bad_steps = 0       # total non-finite steps seen (host mirror)
+        self._rollbacks = 0
         self._writer = (SummaryWriter(log_dir, app_name)
                         if log_dir else None)
         self._ts: Optional[Dict[str, Any]] = None  # train state pytree
@@ -266,7 +313,12 @@ class ZooEstimator:
               "state": jax.device_put(variables["state"], replicated),
               "opt_state": opt_state,
               "step": jax.device_put(jnp.zeros((), jnp.int32), replicated),
-              "rng": jax.device_put(rng, replicated)}
+              "rng": jax.device_put(rng, replicated),
+              # on-device non-finite-step counter (nan_policy="skip_step"
+              # increments it inside the jit step; others leave it at the
+              # host mirror's value) — in ts so it checkpoints with step
+              "bad_steps": jax.device_put(jnp.zeros((), jnp.int32),
+                                          replicated)}
         self._ts = ts
         self._build_steps(mesh)
 
@@ -276,6 +328,8 @@ class ZooEstimator:
         aux_w = self.aux_loss_weight
 
         accum = self.grad_accum
+        guard_skip = self.nan_policy == "skip_step"
+        guard_host = self.nan_policy in ("warn", "rollback", "raise")
 
         def train_step(ts, batch):
             step_rng = jax.random.fold_in(ts["rng"], ts["step"])
@@ -324,9 +378,36 @@ class ZooEstimator:
             updates, opt_state = tx.update(grads, ts["opt_state"],
                                            ts["params"])
             params = optax.apply_updates(ts["params"], updates)
+            bad_steps = ts["bad_steps"]
+            if guard_skip:
+                # in-jit self-healing: a non-finite loss or gradient keeps
+                # params/state/opt_state at their pre-step values.  Must
+                # live inside the compiled step — donate_argnums=0 means
+                # the pre-step buffers are gone once the call returns, so
+                # a host-side "skip" could never restore them.
+                ok = jnp.isfinite(loss_val) & jnp.isfinite(
+                    optax.global_norm(grads))
+
+                def keep(new, old):
+                    return jnp.where(ok, new, old)
+
+                params = jax.tree_util.tree_map(keep, params, ts["params"])
+                new_state = jax.tree_util.tree_map(keep, new_state,
+                                                   ts["state"])
+                opt_state = jax.tree_util.tree_map(keep, opt_state,
+                                                   ts["opt_state"])
+                bad_steps = bad_steps + jnp.where(ok, 0, 1).astype(jnp.int32)
+            elif guard_host:
+                # host policies read only the loss — fold the gradient
+                # check into it so a finite-loss / non-finite-grad step
+                # (backward-only overflow) is not missed: report NaN, and
+                # the host-side policy reacts exactly as for a NaN loss
+                loss_val = jnp.where(
+                    jnp.isfinite(optax.global_norm(grads)), loss_val,
+                    jnp.nan)
             new_ts = {"params": params, "state": new_state,
                       "opt_state": opt_state, "step": ts["step"] + 1,
-                      "rng": ts["rng"]}
+                      "rng": ts["rng"], "bad_steps": bad_steps}
             return new_ts, loss_val
 
         def eval_step(ts, batch):
@@ -401,17 +482,25 @@ class ZooEstimator:
         feed = as_feed(data, batch_size, seed=self.seed)
         trigger = Trigger.get(checkpoint_trigger)
         history: Dict[str, List[float]] = {"loss": []}
+        start_epoch = self._epoch
+        target_epoch = self._epoch + epochs
+        faults = faults_lib.get_registry()
+        host_nan_check = self.nan_policy in ("warn", "rollback", "raise")
 
         if self._preempt is not None:
             self._preempt.active = True
         ZooEstimator._device_lock.acquire()
         try:
             first = True
-            for _ in range(epochs):
+            # while (not for): nan_policy="rollback" rewinds self._epoch to
+            # the restored checkpoint's epoch and re-runs from there
+            while self._epoch < target_epoch:
                 # monotonic: a wall-clock step (NTP) mid-epoch must not
                 # produce negative or wildly wrong throughput numbers
                 t0 = time.monotonic()
                 losses = []
+                bad_before = self.bad_steps
+                rolled_back = False
                 for batch in feed.epoch(mesh, self._epoch):
                     if "mask" in batch:
                         # a padded final batch from a stream feed: training
@@ -424,12 +513,40 @@ class ZooEstimator:
                     if first:
                         self._ensure_initialized(batch["x"])
                         first = False
+                    # liveness beat for the zoo-launch gang supervisor
+                    # (no-op unless a heartbeat file is configured)
+                    heartbeat()
+                    # worker fault seams (core/faults.py): a hard worker
+                    # death and a wedged step, both disarmed no-ops in
+                    # production and armed by gang-supervision tests
+                    if faults.fire("worker.crash"):
+                        logger.error("injected worker.crash at step %d",
+                                     self._py_step)
+                        os._exit(1)
+                    faults.fire("worker.hang")  # armed delay = hung step
+                    if faults.fire("step.nan"):
+                        batch = _poison_batch(batch)
                     self._maybe_profile()
                     self._ts, loss_val = self._train_step(self._ts, batch)
                     losses.append(loss_val)
                     # track the step in Python: reading self._ts["step"]
                     # would force a device sync on every iteration
                     self._py_step += 1
+                    if host_nan_check and not math.isfinite(
+                            float(loss_val)):
+                        self.bad_steps += 1
+                        if self.nan_policy == "raise":
+                            self._stop_profile()
+                            raise NonFiniteLossError(self._py_step)
+                        if self.nan_policy == "warn":
+                            logger.warning(
+                                "non-finite loss at step %d (nan_policy="
+                                "'warn'): training continues on possibly "
+                                "poisoned parameters", self._py_step)
+                        else:
+                            self._rollback_to_checkpoint()
+                            rolled_back = True
+                            break
                     if (self._preempt is not None
                             and self._preempt.should_checkpoint(
                                 self._py_step)):
@@ -440,6 +557,15 @@ class ZooEstimator:
                     if trigger and self.model_dir and trigger.fires(
                             step=self._py_step, epoch_end=False):
                         self.save(self.model_dir)
+                if rolled_back:
+                    # epoch/step rewound to the restored ckpt; drop history
+                    # entries for epochs about to be re-run (a mid-epoch
+                    # checkpoint rewinds into an already-recorded epoch) so
+                    # len(history["loss"]) stays == epochs actually reported
+                    keep = max(0, self._epoch - start_epoch)
+                    for v in history.values():
+                        del v[keep:]
+                    continue
                 if not losses:
                     raise ValueError(
                         "fit got no full batches (dataset smaller than one "
@@ -447,15 +573,29 @@ class ZooEstimator:
                         "batch_size")
                 self._epoch += 1
                 # one host sync per epoch, not per step: losses were left
-                # on device
-                epoch_loss = float(jnp.stack(losses).mean())
+                # on device.  Under skip_step, skipped steps report NaN
+                # loss but did not touch params — exclude them from the
+                # epoch mean and read back the on-device bad counter.
+                stacked = jnp.stack(losses)
+                if self.nan_policy == "skip_step":
+                    epoch_loss = float(jnp.nanmean(stacked))
+                    self.bad_steps = int(self._ts["bad_steps"])
+                else:
+                    epoch_loss = float(stacked.mean())
                 history["loss"].append(epoch_loss)
+                if self.nan_policy is not None:
+                    history.setdefault("bad_steps", []).append(
+                        self.bad_steps - bad_before)
                 dt = time.monotonic() - t0
                 n = len(losses) * feed.global_batch
                 if self._writer:
                     self._writer.add_scalar("loss", epoch_loss, self._epoch)
                     self._writer.add_scalar("throughput", n / dt,
                                             self._epoch)
+                    if self.nan_policy is not None:
+                        self._writer.add_scalar(
+                            "bad_steps", self.bad_steps - bad_before,
+                            self._epoch)
                 if verbose:
                     logger.info("epoch %d: loss=%.4f (%.1f examples/s)",
                                 self._epoch, epoch_loss, n / dt)
@@ -475,6 +615,33 @@ class ZooEstimator:
             if self._preempt is not None:
                 self._preempt.active = False
         return history
+
+    def _rollback_to_checkpoint(self) -> None:
+        """nan_policy="rollback": restore the latest ``model_dir``
+        checkpoint (params, optimizer, step, epoch) and let fit() re-run
+        from there.  Bounded by ``nan_max_rollbacks`` — a deterministic
+        NaN (bad data, bad LR) would otherwise loop forever."""
+        self._rollbacks += 1
+        if self._rollbacks > self.nan_max_rollbacks:
+            self._stop_profile()
+            raise NonFiniteLossError(
+                self._py_step,
+                f"non-finite loss at step {self._py_step}: rollback budget "
+                f"({self.nan_max_rollbacks}) exhausted — the fault is "
+                f"deterministic, not transient")
+        if not (self.model_dir and ckpt_io.exists(self.model_dir)):
+            self._stop_profile()
+            raise NonFiniteLossError(
+                self._py_step,
+                f"non-finite loss at step {self._py_step}: nan_policy="
+                "'rollback' found no checkpoint in model_dir (configure "
+                "model_dir and a checkpoint_trigger)")
+        logger.warning(
+            "non-finite loss at step %d: rolling back to the last "
+            "checkpoint in %s (rollback %d/%d)", self._py_step,
+            self.model_dir, self._rollbacks, self.nan_max_rollbacks)
+        # under the device lock already (fit holds the RLock)
+        self._load_locked(self.model_dir)
 
     def _maybe_profile(self) -> None:
         if self.profile_dir is None:
@@ -524,6 +691,7 @@ class ZooEstimator:
         # step_mask zero-weights the padded tail positions either way
         with ZooEstimator._device_lock:
             for step, batch in enumerate(feed.epoch(mesh, 0)):
+                heartbeat()  # long validation sweeps must stay "alive" too
                 totals = accumulate(totals, batch, step)
             if feed.drop_remainder:
                 # user-constructed training feed: cover the dropped tail
@@ -569,6 +737,7 @@ class ZooEstimator:
         outs: List[np.ndarray] = []
         with ZooEstimator._device_lock:
             for batch in feed.epoch(mesh, 0):
+                heartbeat()  # long prediction sweeps are progress too
                 self._ensure_initialized(batch["x"])
                 outs.append(_to_local_rows(self._pred_step(self._ts,
                                                            batch["x"])))
@@ -611,6 +780,13 @@ class ZooEstimator:
         # cross-host (ZeRO-3) checkpoint is never densely assembled
         tree = ckpt_io.restore(path, mesh=mesh)
         self._py_step = int(np.asarray(tree["step"]))
+        if self.nan_policy == "skip_step":
+            # sync the host mirror with the restored on-device counter so
+            # the first post-resume epoch reports only ITS bad steps, not
+            # the checkpoint's historical total.  Host policies keep their
+            # own mirror (ts never carries their count) — left untouched
+            # so a mid-fit rollback load doesn't erase the triggering step.
+            self.bad_steps = int(np.asarray(tree.get("bad_steps", 0)))
         self._epoch = int(ckpt_io.load_extra(path).get("epoch",
                                                        self._epoch))
         rules = _resolve_sharding_rules(self.sharding)
@@ -650,7 +826,11 @@ class ZooEstimator:
                     "step": jax.device_put(jnp.asarray(tree["step"]),
                                            replicated),
                     "rng": jax.device_put(jnp.asarray(tree["rng"]),
-                                          replicated)}
+                                          replicated),
+                    # pre-self-healing checkpoints have no bad_steps leaf
+                    "bad_steps": jax.device_put(
+                        jnp.asarray(tree.get("bad_steps", 0), jnp.int32),
+                        replicated)}
         if self._train_step is None:
             self._build_steps(mesh)
 
@@ -678,6 +858,24 @@ class ZooEstimator:
 
 def _first_leaf(tree: Any) -> jax.Array:
     return jax.tree_util.tree_leaves(tree)[0]
+
+
+def _poison_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """``step.nan`` injection: NaN-fill every float leaf of the batch so
+    the non-finite propagates through the REAL forward/backward (loss AND
+    gradients go bad), exercising the same guard path a numerical blowup
+    would.  Integer leaves (token ids, labels) pass through — NaN is not
+    representable there and embedding lookups must stay in range.  The
+    multiply (not a rebuild) keeps each leaf's device placement/sharding
+    exactly as the feed delivered it."""
+
+    def nan_fill(a):
+        if np.issubdtype(np.dtype(a.dtype), np.floating):
+            return a * a.dtype.type(np.nan)
+        return a
+
+    return {k: jax.tree_util.tree_map(nan_fill, v)
+            for k, v in batch.items()}
 
 
 def _pad_remainder(rem: Dict[str, Any], feed: Any, mesh) -> Dict[str, Any]:

@@ -33,6 +33,23 @@ def _fresh_context():
     stop_orca_context()
 
 
+@pytest.fixture(autouse=True)
+def _fault_registry_disarmed():
+    """Suite hygiene: a test that arms a fault-injection point must disarm
+    it (use ``registry.armed(...)`` — it always does).  A leaked armed
+    fault fails the test that leaked it, not the innocent test 200 ids
+    later that trips over it."""
+    yield
+    from analytics_zoo_tpu.core import faults
+    reg = faults.get_registry()
+    leaked = reg.armed_points()
+    if leaked:
+        reg.reset()  # disarm so subsequent tests run clean
+        pytest.fail(f"test leaked armed fault injection points: {leaked} "
+                    "(arm with registry.armed(...) or disable() in "
+                    "teardown)")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_accumulated_state():
     """Full-suite hygiene: 360+ tests in one process accumulate jit

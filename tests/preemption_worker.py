@@ -1,8 +1,25 @@
-"""Worker for the preemption test: trains "forever" until SIGTERM arrives,
-then exits 143 after the consensus checkpoint (core/failover.py).  On a
-second run with a checkpoint present, auto-resumes and prints the resumed
-step."""
+"""Worker for the preemption and gang-supervision tests.
 
+Default mode: trains "forever" until SIGTERM arrives, then exits 143
+after the consensus checkpoint (core/failover.py).  On a second run with
+a checkpoint present, auto-resumes and prints the resumed step.
+
+Gang mode (``ZOO_GANG_MODE=1``, set by the zoo-launch supervisor tests):
+each worker of the gang trains independently into
+``<model_dir>/w<ZOO_PROCESS_ID>`` with an every-epoch checkpoint trigger
+and ``auto_resume``, and writes ``<model_dir>/done_w<pid>`` with its
+final step on success.  On the FIRST attempt (``ZOO_RESTART_COUNT=0``)
+the worker whose rank equals ``ZOO_TEST_FAULT_WORKER`` arms the
+requested injection point:
+
+- ``ZOO_TEST_CRASH_AFTER=K``  →  ``worker.crash`` (os._exit) at step K+1
+- ``ZOO_TEST_HANG_DELAY=S`` [+ ``ZOO_TEST_HANG_AFTER=K``]  →
+  ``worker.hang`` wedges one step for S seconds (heartbeats stop)
+
+so the supervisor's crash/hang handling runs against real processes,
+deterministically."""
+
+import os
 import sys
 
 import numpy as np
@@ -18,11 +35,29 @@ def main() -> None:
     from analytics_zoo_tpu.core import Preempted, init_orca_context
     from analytics_zoo_tpu.orca.learn import Estimator
 
+    gang = os.environ.get("ZOO_GANG_MODE") == "1"
+    pid = os.environ.get("ZOO_PROCESS_ID", "0")
+    base_dir = model_dir
+    if gang:
+        model_dir = os.path.join(base_dir, f"w{pid}")
+        if (os.environ.get("ZOO_TEST_FAULT_WORKER") == pid
+                and os.environ.get("ZOO_RESTART_COUNT", "0") == "0"):
+            from analytics_zoo_tpu.core import faults
+            crash_after = os.environ.get("ZOO_TEST_CRASH_AFTER")
+            if crash_after is not None:
+                faults.get_registry().enable(
+                    "worker.crash", times=1, after=int(crash_after))
+            hang_delay = os.environ.get("ZOO_TEST_HANG_DELAY")
+            if hang_delay is not None:
+                faults.get_registry().enable(
+                    "worker.hang", times=1, delay=float(hang_delay),
+                    after=int(os.environ.get("ZOO_TEST_HANG_AFTER", "0")))
+
     init_orca_context("local")
     model = nn.Sequential([nn.Dense(8, activation="relu"), nn.Dense(1)])
     est = Estimator.from_keras(model, loss="mse", learning_rate=1e-3,
                                model_dir=model_dir,
-                               preemption_checkpoint=True,
+                               preemption_checkpoint=not gang,
                                preemption_sync_every=2)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(256, 4)).astype(np.float32)
@@ -30,10 +65,14 @@ def main() -> None:
     print("TRAINING_STARTED", flush=True)
     try:
         est.fit((x, y), epochs=epochs, batch_size=32, auto_resume=True,
+                checkpoint_trigger="every_epoch" if gang else None,
                 verbose=False)
     except Preempted as e:
         print(f"PREEMPTED step={e.step} path={e.path}", flush=True)
         sys.exit(143)
+    if gang:
+        with open(os.path.join(base_dir, f"done_w{pid}"), "w") as f:
+            f.write(str(est._py_step))
     print(f"FINISHED step={est._py_step}", flush=True)
 
 

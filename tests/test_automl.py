@@ -217,3 +217,81 @@ def test_fit_args_apply_to_preexisting_engine():
              scheduler=sched, max_concurrent=2)
     assert eng.max_concurrent == 2
     assert eng.scheduler is sched
+
+
+def test_trial_timeout_does_not_wedge_search():
+    """A trial that blows its wall-clock budget is marked
+    status="timeout"; the search completes on the other trials."""
+    import time as _time
+    from analytics_zoo_tpu.automl import GridSearchEngine, hp
+
+    def trial(config, report):
+        if config["x"] == 0:
+            _time.sleep(3.0)  # never reports: only the hard wall can stop it
+        return float(config["x"])
+
+    eng = GridSearchEngine(metric_mode="min", trial_timeout_s=0.4)
+    best = eng.run(trial, {"x": hp.choice([0, 1, 2])}, n_trials=3)
+    statuses = {t.config["x"]: t.status for t in eng.trials}
+    assert statuses[0] == "timeout"
+    assert statuses[1] == statuses[2] == "done"
+    assert best.metric == 1.0
+    slow = next(t for t in eng.trials if t.config["x"] == 0)
+    assert slow.duration_s < 2.5  # returned at the wall, not after sleep
+
+
+def test_trial_timeout_cooperative_via_report():
+    """A trial that reports hits the cooperative deadline check and is
+    stopped from inside (keeping its partial metric)."""
+    import time as _time
+    from analytics_zoo_tpu.automl import RandomSearchEngine, hp
+
+    def trial(config, report):
+        for step in range(100):
+            _time.sleep(0.05)
+            report(10.0 - step, step)
+        return 0.0
+
+    eng = RandomSearchEngine(metric_mode="min", trial_timeout_s=0.3,
+                             seed=0)
+    # the timed-out trial keeps its best reported metric, so the search
+    # still returns it as a scored result
+    best = eng.run(trial, {"x": hp.uniform(0, 1)}, n_trials=1)
+    t = eng.trials[0]
+    assert t.status == "timeout"
+    assert t.history  # partial reports retained
+    assert t.metric == min(t.history)
+    assert best is t
+
+
+def test_trial_transient_failure_retried():
+    from analytics_zoo_tpu.automl import RandomSearchEngine, hp
+    attempts = {}
+
+    def trial(config, report):
+        key = round(config["x"], 6)
+        attempts[key] = attempts.get(key, 0) + 1
+        if attempts[key] == 1:
+            raise ConnectionError("transient blip")
+        return config["x"]
+
+    eng = RandomSearchEngine(metric_mode="min", trial_retries=1, seed=0)
+    best = eng.run(trial, {"x": hp.uniform(0, 1)}, n_trials=4)
+    assert best.metric is not None
+    for t in eng.trials:
+        assert t.status == "done"
+        assert t.retries == 1  # one transient failure absorbed each
+
+
+def test_trial_retry_budget_exhausted_is_error():
+    from analytics_zoo_tpu.automl import RandomSearchEngine, hp
+
+    def trial(config, report):
+        raise RuntimeError("always broken")
+
+    eng = RandomSearchEngine(metric_mode="min", trial_retries=2, seed=0)
+    with pytest.raises(RuntimeError, match="all 2 trials failed"):
+        eng.run(trial, {"x": hp.uniform(0, 1)}, n_trials=2)
+    for t in eng.trials:
+        assert t.status == "error"
+        assert t.retries == 2

@@ -1,9 +1,12 @@
-"""Preemption-safe training tests (SURVEY.md §5.3 failure recovery).
+"""Failure-recovery tests (SURVEY.md §5.3): preemption checkpointing,
+zoo-launch gang supervision, and training-loop self-healing.
 
-The real contract — SIGTERM mid-training → checkpoint lands → process
-exits → a fresh process resumes from the step it left — is exercised with
-actual OS signals on a subprocess, the cluster-in-a-box way the reference
-tested failure paths."""
+The real contracts — SIGTERM mid-training → checkpoint lands → process
+exits → a fresh process resumes; a crashed/hung gang worker → supervisor
+kills and relaunches the gang → workers auto-resume — are exercised with
+actual OS processes and signals, the cluster-in-a-box way the reference
+tested failure paths.  The NaN self-healing policies run in-process with
+the ``step.nan`` injection point."""
 
 import os
 import re
@@ -13,6 +16,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "preemption_worker.py")
@@ -192,3 +196,323 @@ def test_signal_handler_is_lock_free():
     # outside the handler the deferred warning drains via normal reads
     assert g.flagged
     assert g.should_checkpoint(1)
+
+
+# -- gang supervision (core/launcher.py) -------------------------------------
+# Fast supervisor-logic tests use tiny non-jax scripts; the end-to-end gang
+# test (the acceptance contract) spawns real training workers.
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+@pytest.mark.gang
+def test_supervisor_restarts_crashed_gang(tmp_path):
+    from analytics_zoo_tpu.core.launcher import launch
+    s = _script(tmp_path, "s.py",
+                "import os, sys\n"
+                "sys.exit(1 if os.environ['ZOO_RESTART_COUNT'] == '0' "
+                "else 0)\n")
+    events = []
+    rc = launch(s, [], nprocs=2, max_restarts=1, backoff=0.05, grace=1.0,
+                on_event=lambda k, i: events.append((k, i)))
+    assert rc == 0
+    kinds = [k for k, _ in events]
+    assert kinds == ["crash", "restart", "ok"]
+    assert events[0][1]["rc"] == 1
+
+
+@pytest.mark.gang
+def test_supervisor_detects_dead_worker_promptly(tmp_path):
+    """A dead worker must be detected while its siblings still run — the
+    pre-supervisor sequential wait() could block up to nprocs * timeout."""
+    from analytics_zoo_tpu.core.launcher import launch
+    s = _script(tmp_path, "s.py",
+                "import os, sys, time\n"
+                "sys.exit(2) if os.environ['ZOO_PROCESS_ID'] == '0' "
+                "else time.sleep(60)\n")
+    t0 = time.monotonic()
+    rc = launch(s, [], nprocs=3, max_restarts=0, grace=0.5)
+    assert rc == 2
+    assert time.monotonic() - t0 < 20  # nowhere near the 60 s sleeper
+
+
+@pytest.mark.gang
+def test_supervisor_crash_loop_aborts_with_diagnosis(tmp_path):
+    from analytics_zoo_tpu.core.launcher import EXIT_CRASH_LOOP, launch
+    s = _script(tmp_path, "s.py",
+                "import os, sys, time\n"
+                "sys.exit(3) if os.environ['ZOO_PROCESS_ID'] == '1' "
+                "else time.sleep(60)\n")
+    events = []
+    rc = launch(s, [], nprocs=2, max_restarts=10, backoff=0.05, grace=0.5,
+                crash_loop_threshold=2,
+                on_event=lambda k, i: events.append((k, i)))
+    assert rc == EXIT_CRASH_LOOP
+    assert events[-1][0] == "crash_loop"
+    assert events[-1][1]["rank"] == 1
+    # budget was NOT exhausted: the loop was diagnosed after 2 attempts
+    assert sum(1 for k, _ in events if k == "crash") == 2
+
+
+@pytest.mark.gang
+def test_supervisor_restart_budget_exhausted_returns_rc(tmp_path):
+    from analytics_zoo_tpu.core.launcher import launch
+    s = _script(tmp_path, "s.py", "import sys\nsys.exit(7)\n")
+    rc = launch(s, [], nprocs=1, max_restarts=1, backoff=0.05, grace=0.5,
+                crash_loop_threshold=5)
+    assert rc == 7
+
+
+@pytest.mark.gang
+def test_supervisor_kills_and_restarts_on_heartbeat_loss(tmp_path):
+    """A worker that never beats (hung before/at startup) is killed and
+    the gang restarted — hung workers must not stall the job forever."""
+    from analytics_zoo_tpu.core.launcher import launch
+    s = _script(tmp_path, "s.py",
+                "import os, sys, time\n"
+                "time.sleep(60) if os.environ['ZOO_RESTART_COUNT'] == '0' "
+                "else sys.exit(0)\n")
+    events = []
+    t0 = time.monotonic()
+    rc = launch(s, [], nprocs=2, max_restarts=1, backoff=0.05, grace=0.5,
+                heartbeat_timeout=1.0,
+                on_event=lambda k, i: events.append((k, i)))
+    assert rc == 0
+    assert [k for k, _ in events] == ["hang", "restart", "ok"]
+    assert time.monotonic() - t0 < 30
+
+
+@pytest.mark.gang
+def test_supervisor_slow_but_beating_worker_is_left_alone(tmp_path):
+    """Hung vs slow: a worker that keeps touching its heartbeat file is
+    slow, not dead — no restart even while it takes >> heartbeat_timeout."""
+    from analytics_zoo_tpu.core.launcher import launch
+    s = _script(tmp_path, "s.py",
+                "import os, time\n"
+                "hb = os.environ['ZOO_HEARTBEAT_FILE']\n"
+                "for _ in range(8):\n"
+                "    time.sleep(0.25)\n"
+                "    os.utime(hb, None)\n")
+    events = []
+    rc = launch(s, [], nprocs=2, max_restarts=1, backoff=0.05, grace=0.5,
+                heartbeat_timeout=1.0,
+                on_event=lambda k, i: events.append((k, i)))
+    assert rc == 0
+    assert [k for k, _ in events] == ["ok"]  # ran ~2 s, never restarted
+
+
+@pytest.mark.gang
+def test_gang_crash_restart_resumes_to_completion(tmp_path):
+    """THE acceptance contract: a 3-worker zoo-launch gang with
+    ``worker.crash`` armed on worker 1 (via the injection point inside the
+    train loop) finishes training with the correct final step — the
+    supervisor terminates the gang on the crash, relaunches it, and every
+    worker auto-resumes from its epoch checkpoint."""
+    from analytics_zoo_tpu.core.launcher import launch
+    env = {"ZOO_GANG_MODE": "1", "ZOO_TEST_FAULT_WORKER": "1",
+           "ZOO_TEST_CRASH_AFTER": "10",  # crash at step 11, mid-epoch 2
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            ""),
+           "JAX_PLATFORMS": "cpu"}
+    old = {k: os.environ.get(k)
+           for k in list(env) + ["PALLAS_AXON_POOL_IPS"]}
+    os.environ.update(env)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    events = []
+    try:
+        rc = launch(WORKER, [str(tmp_path), "3"], nprocs=3,
+                    platform="cpu", max_restarts=2, backoff=0.1,
+                    grace=15.0,
+                    on_event=lambda k, i: events.append((k, i)))
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert rc == 0, events
+    kinds = [k for k, _ in events]
+    assert kinds == ["crash", "restart", "ok"], events
+    assert events[0][1]["rank"] == 1  # the armed worker was the culprit
+    # every worker reached the exact final step: 3 epochs x 8 steps
+    for pid in range(3):
+        done = tmp_path / f"done_w{pid}"
+        assert done.exists(), f"worker {pid} never finished"
+        assert int(done.read_text()) == 24
+
+
+# -- training-loop self-healing (nan_policy) ---------------------------------
+
+def _small_fit_setup():
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64, 1)).astype(np.float32)
+
+    def mkest(**kw):
+        model = nn.Sequential([nn.Dense(8, activation="relu"),
+                               nn.Dense(1)])
+        return Estimator.from_keras(model, loss="mse", learning_rate=1e-3,
+                                    **kw)
+
+    return mkest, x, y
+
+
+@pytest.mark.faults
+def test_nan_policy_warn_counts_and_continues():
+    from analytics_zoo_tpu.core import faults
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="warn")
+    with faults.get_registry().armed("step.nan", times=1, after=1):
+        hist = est.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    assert est.bad_steps == 1
+    assert hist["bad_steps"] == [1]
+    assert faults.get_registry().fired("step.nan") == 1
+
+
+@pytest.mark.faults
+def test_nan_policy_skip_step_keeps_params_finite():
+    import jax
+    from analytics_zoo_tpu.core import faults
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="skip_step")
+    with faults.get_registry().armed("step.nan", times=1, after=1):
+        hist = est.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    # the poisoned step was skipped on-device: params stayed finite and
+    # the epoch loss (nanmean over the good steps) is finite
+    assert est.bad_steps == 1
+    assert hist["bad_steps"] == [1]
+    assert np.isfinite(hist["loss"][0])
+    leaves = jax.tree_util.tree_leaves(est.get_model()["params"])
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+
+@pytest.mark.faults
+def test_nan_policy_raise_raises():
+    from analytics_zoo_tpu.core import faults
+    from analytics_zoo_tpu.orca.learn import NonFiniteLossError
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="raise")
+    with faults.get_registry().armed("step.nan", times=1):
+        with pytest.raises(NonFiniteLossError, match="non-finite loss"):
+            est.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    assert est.bad_steps == 1
+
+
+@pytest.mark.faults
+def test_nan_policy_rollback_recovers_pre_nan_checkpoint(tmp_path):
+    """Acceptance contract: an armed ``step.nan`` under
+    ``policy="rollback"`` recovers to the pre-NaN checkpoint — the final
+    history equals a clean run's (same seed, same data, NaN step never
+    applied) and training completes every epoch."""
+    from analytics_zoo_tpu.core import faults, stop_orca_context
+    mkest, x, y = _small_fit_setup()
+    clean = mkest().fit((x, y), epochs=2, batch_size=32, verbose=False)
+
+    stop_orca_context()
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="rollback", model_dir=str(tmp_path / "ckpt"))
+    # 2 steps/epoch; checkpoint at each epoch end; NaN on step 3 (epoch 2)
+    with faults.get_registry().armed("step.nan", times=1, after=2):
+        hist = est.fit((x, y), epochs=2, batch_size=32,
+                       checkpoint_trigger="every_epoch", verbose=False)
+    assert est._rollbacks == 1
+    assert est.bad_steps == 1
+    assert est._py_step == 4  # rewound to step 2, re-ran epoch 2 cleanly
+    np.testing.assert_allclose(hist["loss"], clean["loss"], rtol=1e-6)
+
+
+@pytest.mark.faults
+def test_nan_policy_rollback_without_checkpoint_raises():
+    from analytics_zoo_tpu.core import faults
+    from analytics_zoo_tpu.orca.learn import NonFiniteLossError
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="rollback")  # no model_dir -> nothing to restore
+    with faults.get_registry().armed("step.nan", times=1):
+        with pytest.raises(NonFiniteLossError, match="no checkpoint"):
+            est.fit((x, y), epochs=1, batch_size=32, verbose=False)
+
+
+def test_nan_policy_validated():
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.orca.learn import Estimator
+    with pytest.raises(ValueError, match="nan_policy"):
+        Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse",
+                             nan_policy="explode")
+
+
+# -- worker heartbeat (core/context.py) --------------------------------------
+
+def test_fit_beats_heartbeat_file(tmp_path):
+    """The training loop reports liveness: with a heartbeat file
+    configured, fit() touches it on progress (the supervisor's hung-vs-
+    slow signal)."""
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import ZooConfig, init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    hb = tmp_path / "hb"
+    init_orca_context("local", config=ZooConfig(
+        heartbeat_file=str(hb), heartbeat_interval=0.01))
+    assert hb.exists()  # first beat lands at init ("import finished")
+    mtime0 = hb.stat().st_mtime
+    time.sleep(0.05)
+    rng = np.random.default_rng(0)
+    est = Estimator.from_keras(
+        nn.Sequential([nn.Dense(1)]), loss="mse", learning_rate=1e-3)
+    est.fit((rng.normal(size=(64, 4)).astype(np.float32),
+             rng.normal(size=(64, 1)).astype(np.float32)),
+            epochs=1, batch_size=32, verbose=False)
+    assert hb.stat().st_mtime > mtime0
+
+
+def test_heartbeat_env_contract(tmp_path, monkeypatch):
+    """init_orca_context picks the heartbeat file up from the env vars the
+    zoo-launch supervisor sets."""
+    from analytics_zoo_tpu.core import OrcaContext, init_orca_context
+    hb = tmp_path / "hb_env"
+    monkeypatch.setenv("ZOO_HEARTBEAT_FILE", str(hb))
+    monkeypatch.setenv("ZOO_HEARTBEAT_INTERVAL", "0.25")
+    init_orca_context("local")
+    assert hb.exists()
+    assert OrcaContext.config.heartbeat_interval == 0.25
+
+
+@pytest.mark.faults
+def test_worker_hang_fault_wedges_a_step():
+    """The ``worker.hang`` seam sits in the train loop: an armed delay
+    stalls exactly one step (and with it the heartbeat) — the injection
+    the supervisor-side heartbeat tests build on."""
+    from analytics_zoo_tpu.core import faults
+    mkest, x, y = _small_fit_setup()
+    est = mkest()
+    t0 = time.monotonic()
+    with faults.get_registry().armed("worker.hang", times=1, delay=0.3):
+        est.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    assert time.monotonic() - t0 >= 0.3
+    assert faults.get_registry().fired("worker.hang") == 1
+
+
+@pytest.mark.faults
+def test_skip_step_bad_counter_survives_resume(tmp_path):
+    """Resume semantics for the on-device bad-step counter: a fresh
+    estimator loading a skip_step checkpoint syncs its host mirror, so
+    post-resume epochs report only THEIR bad steps."""
+    from analytics_zoo_tpu.core import faults
+    mkest, x, y = _small_fit_setup()
+    est = mkest(nan_policy="skip_step", model_dir=str(tmp_path / "ck"))
+    with faults.get_registry().armed("step.nan", times=1, after=1):
+        est.fit((x, y), epochs=1, batch_size=32,
+                checkpoint_trigger="every_epoch", verbose=False)
+    assert est.bad_steps == 1
+    est2 = mkest(nan_policy="skip_step", model_dir=str(tmp_path / "ck"))
+    est2.load()
+    assert est2.bad_steps == 1  # host mirror synced from the checkpoint
+    hist = est2.fit((x, y), epochs=2, batch_size=32, verbose=False)
+    # the resumed epochs ran clean: per-epoch counts exclude the
+    # checkpoint's historical bad step
+    assert hist["bad_steps"] == [0, 0]
+    assert est2.bad_steps == 1  # total still includes history

@@ -215,3 +215,81 @@ def test_feed_stall_point_is_wired():
     before = get_registry().hits("feed.stall")
     list(feed.epoch(get_mesh(), 0))
     assert get_registry().hits("feed.stall") - before == 2
+
+
+def test_training_fault_points_are_known():
+    # PR 2 (gang supervision + self-healing) injection points
+    for name in ("worker.crash", "worker.hang", "feed.read_fail",
+                 "step.nan"):
+        assert name in KNOWN_POINTS
+
+
+def test_after_skips_initial_hits():
+    """``after=K`` arms "fire on hit K+1": the deterministic handle for
+    "crash at step N" in gang tests."""
+    r = FaultRegistry()
+    r.enable("step.nan", times=1, after=3)
+    fires = [r.fire("step.nan") for _ in range(6)]
+    assert fires == [False, False, False, True, False, False]
+    assert r.hits("step.nan") == 6
+    assert r.fired("step.nan") == 1
+
+
+def test_after_validates_non_negative():
+    r = FaultRegistry()
+    with pytest.raises(ValueError, match="after"):
+        r.enable("step.nan", after=-1)
+
+
+def test_armed_points_lists_and_clears():
+    r = FaultRegistry()
+    assert r.armed_points() == []
+    r.enable("feed.stall")
+    r.enable("step.nan", times=1)
+    assert r.armed_points() == ["feed.stall", "step.nan"]
+    r.fire("step.nan")  # last charge consumed: auto-disarmed
+    assert r.armed_points() == ["feed.stall"]
+    r.reset()
+    assert r.armed_points() == []
+
+
+def test_feed_read_fail_point_is_wired_and_retried():
+    """StreamingDataFeed hits ``feed.read_fail`` inside its retry loop: an
+    armed one-shot failure is absorbed by retries=1 and every row still
+    arrives exactly once."""
+    import numpy as np
+    from analytics_zoo_tpu.core import get_mesh, init_orca_context
+    from analytics_zoo_tpu.data import StreamingDataFeed
+    init_orca_context("local")
+    feed = StreamingDataFeed(
+        num_samples=8,
+        load_sample=lambda i, rng=None: {"x": np.full((2,), float(i),
+                                                      np.float32)},
+        batch_size=4, shuffle=False, num_workers=1, retries=1)
+    with get_registry().armed("feed.read_fail", times=1):
+        batches = list(feed.epoch(get_mesh(), 0))
+    assert get_registry().fired("feed.read_fail") == 1
+    assert feed.load_failures == 1
+    assert feed.skipped_rows == 0  # retried, not skipped
+    rows = sorted(float(v) for b in batches
+                  for v in np.asarray(b["x"])[:, 0])
+    assert rows == [float(i) for i in range(8)]
+
+
+def test_step_nan_point_is_wired():
+    """The estimator hits ``step.nan`` once per train step; disarmed it
+    must be a pure counter."""
+    import numpy as np
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.orca.learn import Estimator
+    init_orca_context("local")
+    est = Estimator.from_keras(nn.Sequential([nn.Dense(1)]), loss="mse",
+                               learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    before = get_registry().hits("step.nan")
+    est.fit((rng.normal(size=(64, 4)).astype(np.float32),
+             rng.normal(size=(64, 1)).astype(np.float32)),
+            epochs=1, batch_size=32, verbose=False)
+    assert get_registry().hits("step.nan") - before == 2  # 2 steps
+    assert get_registry().fired("step.nan") == 0

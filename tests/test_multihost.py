@@ -21,6 +21,10 @@ import pytest
 @pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
 def test_multiprocess_fit_eval_sharded_checkpoint(tmp_path, nprocs,
                                                   devices_per_proc):
+    import jax
+    if jax.default_backend() == "cpu":
+        pytest.skip("Multiprocess computations aren't implemented on the "
+                    "CPU backend (jax restriction); needs a TPU/GPU run")
     from analytics_zoo_tpu.core.launcher import _child_env, _free_port
 
     coordinator = f"127.0.0.1:{_free_port()}"
