@@ -179,6 +179,12 @@ class SearchEngine:
                                    trial.error)
                 break
             trial.duration_s = time.monotonic() - t0
+            # per-trial telemetry (core/metrics.py): search throughput
+            # and outcome mix, without holding the engine object
+            from analytics_zoo_tpu.core import metrics as metrics_lib
+            reg = metrics_lib.get_registry()
+            reg.observe("automl.trial_ms", trial.duration_s * 1000.0)
+            reg.inc("automl.trials", status=trial.status)
 
         if self.max_concurrent > 1:
             with ThreadPoolExecutor(self.max_concurrent) as pool:

@@ -1,12 +1,16 @@
-"""Core runtime: context bootstrap, config, checkpointing, summaries."""
+"""Core runtime: context bootstrap, config, checkpointing, summaries,
+telemetry (metrics registry + request tracing)."""
 
 from .config import MeshConfig, ZooConfig
 from .context import (OrcaContext, get_mesh, heartbeat, init_nncontext,
                       init_orca_context, make_mesh, stop_orca_context)
 from . import checkpoint
 from . import faults
+from . import metrics
+from . import trace
 from .failover import Preempted, PreemptionGuard
 from .faults import FaultRegistry
+from .metrics import MetricsRegistry
 from .summary import SummaryWriter
 
 __all__ = [
@@ -14,5 +18,5 @@ __all__ = [
     "init_orca_context", "make_mesh", "stop_orca_context", "heartbeat",
     "checkpoint",
     "SummaryWriter", "Preempted", "PreemptionGuard", "faults",
-    "FaultRegistry",
+    "FaultRegistry", "metrics", "MetricsRegistry", "trace",
 ]

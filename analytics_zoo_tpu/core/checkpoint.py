@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from . import faults as faults_lib
+from . import metrics as metrics_lib
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -138,6 +139,7 @@ def save(path: str, tree: Any, step: Optional[int] = None,
     sit after the retried sections, so a process that needed three
     attempts just arrives at the barrier late).
     """
+    t_save = time.monotonic()
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     pidx, pcount = jax.process_index(), jax.process_count()
     os.makedirs(path, exist_ok=True)
@@ -239,6 +241,8 @@ def save(path: str, tree: Any, step: Optional[int] = None,
         multihost_utils.sync_global_devices("zoo_ckpt_meta_written")
     if pidx == 0:
         _gc_stale_generations(path, gen)
+    metrics_lib.get_registry().observe(
+        "checkpoint.save_ms", (time.monotonic() - t_save) * 1000.0)
     return path
 
 
@@ -385,6 +389,7 @@ def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
     the PartitionSpec recorded at save time, on this mesh.  Leaves whose
     spec doesn't fit the mesh assemble densely instead.
     """
+    t_restore = time.monotonic()
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     npz = np.load(os.path.join(path, _data_name(meta.get("gen"))),
@@ -413,7 +418,10 @@ def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
         else:
             leaves.append(_decode_scalar(enc))
     treedef = _treedef_from_json(meta["treedef"])
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    out = jax.tree_util.tree_unflatten(treedef, leaves)
+    metrics_lib.get_registry().observe(
+        "checkpoint.restore_ms", (time.monotonic() - t_restore) * 1000.0)
+    return out
 
 
 def load_extra(path: str) -> dict:

@@ -14,6 +14,7 @@ Gloo, gRPC, plasma, py4j) collapse into this single compiled plane.
 from __future__ import annotations
 
 import atexit
+import json
 import logging
 import os
 import threading
@@ -29,18 +30,31 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 
 class _Heartbeat:
-    """Progress-based worker liveness: ``beat()`` touches the heartbeat
+    """Progress-based worker liveness: ``beat()`` rewrites the heartbeat
     file at most once per ``interval``.  Deliberately NOT a free-running
     daemon thread — a daemon would keep beating while the training loop is
     wedged, which is exactly the failure the supervisor must detect.  The
     training loop calls ``beat()`` every step; a worker whose steps stop
     (hang, deadlock, lost collective) stops beating and the zoo-launch
-    supervisor kills and restarts the gang on heartbeat loss."""
+    supervisor kills and restarts the gang on heartbeat loss.
+
+    The file is not just an mtime: each beat writes a small JSON status
+    payload (``step``, ``loss``, ``samples_per_sec``, ``wall`` — whatever
+    the caller last reported via keyword args) atomically (tmp + rename,
+    so the supervisor never reads a torn write).  The supervisor
+    aggregates these into a periodic gang-status log line and a
+    per-worker ``metrics.jsonl`` (core/launcher.py); the rename keeps the
+    mtime-based staleness check working unchanged."""
 
     def __init__(self, path: str, interval: float):
         self.path = path
         self.interval = max(0.05, float(interval))
         self._last = 0.0
+        self._payload: Dict[str, Any] = {}
+
+    def update(self, **fields: Any) -> None:
+        """Merge status fields into the payload the next beat writes."""
+        self._payload.update(fields)
 
     def beat(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -48,9 +62,11 @@ class _Heartbeat:
             return
         self._last = now
         try:
-            with open(self.path, "a"):
-                pass
-            os.utime(self.path, None)
+            payload = dict(self._payload, wall=time.time())
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(payload))
+            os.replace(tmp, self.path)  # atomic: no torn reads, fresh mtime
         except OSError:  # liveness reporting must never kill training
             logger.debug("heartbeat touch failed for %s", self.path)
 
@@ -58,13 +74,19 @@ class _Heartbeat:
 _HEARTBEAT: Optional[_Heartbeat] = None
 
 
-def heartbeat() -> None:
+def heartbeat(force: bool = False, **status: Any) -> None:
     """Report training progress to the gang supervisor (no-op unless a
     heartbeat file is configured).  Called from the Estimator step loop;
-    long-running custom loops should call it too."""
+    long-running custom loops should call it too.  Keyword args (e.g.
+    ``step=``, ``loss=``, ``samples_per_sec=``) become the JSON status
+    payload the supervisor aggregates into its gang-status line.
+    ``force=True`` bypasses the rate limit — used for milestone beats
+    (epoch end) whose payload must land even on a fast loop."""
     hb = _HEARTBEAT
     if hb is not None:
-        hb.beat()
+        if status:
+            hb.update(**status)
+        hb.beat(force=force)
 
 
 class _ZooContextMeta(type):

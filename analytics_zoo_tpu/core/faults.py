@@ -125,6 +125,10 @@ class FaultRegistry:
         with self._lock:
             self._specs[name] = _Spec(times, prob, exc, message, delay,
                                       after, seed)
+        # telemetry mirror (core/metrics.py): resilience tests can assert
+        # arming/firing via public metrics instead of private state
+        from . import metrics as metrics_lib
+        metrics_lib.get_registry().inc("faults.armed", point=name)
 
     def disable(self, name: str) -> None:
         with self._lock:
@@ -189,6 +193,8 @@ class FaultRegistry:
                         del self._specs[name]
         if fired:
             logger.debug("fault %s fired", name)
+            from . import metrics as metrics_lib
+            metrics_lib.get_registry().inc("faults.fired", point=name)
             if delay > 0:
                 time.sleep(delay)
         return fired

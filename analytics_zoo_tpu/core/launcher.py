@@ -55,6 +55,7 @@ Usage:
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -122,9 +123,80 @@ def _terminate_gang(procs: List[subprocess.Popen], grace: float) -> None:
             p.wait()
 
 
+def _read_heartbeat_payload(path: Optional[str]) -> dict:
+    """The worker's last JSON status payload (context._Heartbeat), or {}
+    for a missing/empty/legacy-touch heartbeat file.  Tolerant by
+    design: the payload is best-effort telemetry, the mtime is the
+    liveness contract."""
+    if path is None:
+        return {}
+    try:
+        with open(path) as f:
+            text = f.read()
+        return json.loads(text) if text.strip() else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+class _GangStatus:
+    """Periodic gang-status aggregation: every ``interval`` seconds the
+    supervisor reads each worker's heartbeat JSON payload, logs ONE
+    line summarizing the whole gang (step/loss/samples-per-sec per
+    rank) and, when ``metrics_dir`` is set, appends each worker's
+    payload to ``metrics_w<rank>.jsonl`` there — the training-side
+    trajectory file the observability docs describe."""
+
+    def __init__(self, interval: Optional[float],
+                 metrics_dir: Optional[str]):
+        self.interval = interval
+        self.metrics_dir = metrics_dir
+        self._last = time.monotonic()
+        if metrics_dir is not None:
+            os.makedirs(metrics_dir, exist_ok=True)
+
+    def maybe_emit(self, procs: List[subprocess.Popen],
+                   hb_files: List[Optional[str]], attempt: int,
+                   force: bool = False) -> None:
+        """``force=True``: the gang just finished an attempt — emit the
+        closing status (the workers' last forced epoch-end beats) even
+        if the interval hasn't elapsed."""
+        if self.interval is None or not any(hb_files):
+            return
+        now = time.monotonic()
+        if not force and now - self._last < self.interval:
+            return
+        self._last = now
+        parts = []
+        for rank, hb in enumerate(hb_files):
+            payload = _read_heartbeat_payload(hb)
+            alive = procs[rank].poll() is None
+            bits = [f"w{rank}"]
+            if not alive:
+                bits.append("exited")
+            for key in ("step", "loss", "samples_per_sec"):
+                if key in payload:
+                    v = payload[key]
+                    bits.append(f"{key}={v:.4g}"
+                                if isinstance(v, float) else f"{key}={v}")
+            parts.append("[" + " ".join(bits) + "]")
+            if self.metrics_dir is not None and payload:
+                rec = dict(payload, rank=rank, attempt=attempt)
+                try:
+                    with open(os.path.join(
+                            self.metrics_dir,
+                            f"metrics_w{rank}.jsonl"), "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                except OSError:
+                    pass  # telemetry must never kill supervision
+        logger.info("gang status (attempt %d): %s", attempt,
+                    " ".join(parts))
+
+
 def _supervise(procs: List[subprocess.Popen], hb_files: List[Optional[str]],
                heartbeat_timeout: Optional[float],
-               timeout: Optional[float], poll_interval: float
+               timeout: Optional[float], poll_interval: float,
+               status: Optional["_GangStatus"] = None,
+               attempt: int = 0
                ) -> Tuple[str, Optional[int], Optional[int]]:
     """Poll the gang until a verdict: ("ok", None, 0), ("crash", rank, rc),
     ("hang", rank, None), or ("timeout", None, None)."""
@@ -146,6 +218,8 @@ def _supervise(procs: List[subprocess.Popen], hb_files: List[Optional[str]],
                         return "hang", rank, None
             elif rc != 0:
                 return "crash", rank, rc
+        if status is not None:
+            status.maybe_emit(procs, hb_files, attempt, force=all_done)
         if all_done:
             return "ok", None, 0
         if timeout is not None and time.monotonic() - start > timeout:
@@ -168,6 +242,8 @@ def launch(script: str, script_args: List[str], nprocs: int,
            grace: float = 5.0,
            poll_interval: float = 0.05,
            crash_loop_threshold: int = 3,
+           metrics_dir: Optional[str] = None,
+           status_interval: Optional[float] = 10.0,
            on_event: Optional[Callable[[str, dict], None]] = None) -> int:
     """Run a gang of ``nprocs`` local processes under supervision.
 
@@ -185,6 +261,13 @@ def launch(script: str, script_args: List[str], nprocs: int,
     gang and raises ``subprocess.TimeoutExpired`` (the pre-supervisor
     contract).  ``on_event(kind, info)`` observes supervisor decisions
     ("crash"/"hang"/"restart"/"crash_loop"/"ok") — tests assert on it.
+
+    ``status_interval``/``metrics_dir``: with heartbeats on, the
+    supervisor reads each worker's heartbeat JSON payload (step, loss,
+    samples/sec — written by ``core.heartbeat(**status)``) every
+    ``status_interval`` seconds, logs one gang-status line, and — when
+    ``metrics_dir`` is given — appends each worker's payload to
+    ``<metrics_dir>/metrics_w<rank>.jsonl`` (docs/observability.md).
     """
     emit = on_event or (lambda kind, info: None)
     hb_dir = heartbeat_dir
@@ -196,7 +279,8 @@ def launch(script: str, script_args: List[str], nprocs: int,
             script, script_args, nprocs, devices_per_proc, coordinator,
             platform, timeout, max_restarts, backoff, backoff_factor,
             max_backoff, heartbeat_timeout, heartbeat_interval, hb_dir,
-            grace, poll_interval, crash_loop_threshold, emit)
+            grace, poll_interval, crash_loop_threshold, emit,
+            metrics_dir, status_interval)
     finally:
         if own_hb_dir:
             import shutil
@@ -208,7 +292,8 @@ def _launch_supervised(script, script_args, nprocs, devices_per_proc,
                        backoff, backoff_factor, max_backoff,
                        heartbeat_timeout, heartbeat_interval, hb_dir,
                        grace, poll_interval, crash_loop_threshold,
-                       emit) -> int:
+                       emit, metrics_dir=None, status_interval=None) -> int:
+    status = _GangStatus(status_interval, metrics_dir)
     attempt = 0
     first_fail_counts: Dict[int, int] = {}
     while True:
@@ -240,7 +325,8 @@ def _launch_supervised(script, script_args, nprocs, devices_per_proc,
                     [sys.executable, script, *script_args], env=env))
             verdict, rank, rc = _supervise(procs, hb_files,
                                            heartbeat_timeout, timeout,
-                                           poll_interval)
+                                           poll_interval, status=status,
+                                           attempt=attempt)
         finally:
             _terminate_gang(procs, grace)
         if verdict == "ok":
@@ -280,6 +366,10 @@ def _launch_supervised(script, script_args, nprocs, devices_per_proc,
 
 def main(argv: Optional[List[str]] = None) -> None:
     import argparse
+    # the supervisor process never goes through init_orca_context, so its
+    # own decisions (crash/restart verdicts, gang-status lines) need a
+    # handler of their own to reach the zoo-launch terminal
+    logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser(
         prog="zoo-launch", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -314,6 +404,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--crash-loop-threshold", type=int, default=3,
                         help="abort (exit %d) when the same worker first-"
                              "fails this many times" % EXIT_CRASH_LOOP)
+    parser.add_argument("--metrics-dir", default=None,
+                        help="append each worker's heartbeat status "
+                             "payload to metrics_w<rank>.jsonl here")
+    parser.add_argument("--status-interval", type=float, default=10.0,
+                        help="seconds between gang-status log lines "
+                             "(heartbeat payload aggregation)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -332,7 +428,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         backoff=args.restart_backoff,
         heartbeat_timeout=args.heartbeat_timeout,
         heartbeat_interval=args.heartbeat_interval,
-        crash_loop_threshold=args.crash_loop_threshold))
+        crash_loop_threshold=args.crash_loop_threshold,
+        metrics_dir=args.metrics_dir,
+        status_interval=args.status_interval))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,388 @@
+"""Process-wide telemetry registry: counters, gauges, histograms.
+
+Production ML systems treat monitoring as a first-class subsystem with
+uniform counters and latency distributions across every layer (the
+TensorFlow system paper makes the point explicitly), and pod-scale TPU
+work leans on step-time/throughput breakdowns as the primary tool for
+finding input-pipeline vs. device bottlenecks.  Before this module the
+repo had five unrelated observability surfaces (``ClusterServing._counters``,
+the resilient client's ``conn.stats``, the HTTP frontend's ad-hoc
+``/stats`` dict, ``Estimator.history``, heartbeat files); this registry is
+the one substrate they all report through.
+
+Design:
+
+- **Cheap on hot paths.**  ``Counter.inc`` / ``Histogram.observe`` are a
+  lock + an integer bump (histograms add one ``bisect``); handles are
+  created once (``registry.counter(name)``) and reused, so the per-event
+  cost is independent of registry size.  ``registry.enabled = False``
+  turns every write into an attribute check + return (the overhead-guard
+  test's baseline).
+- **Named labels.**  A metric identity is ``(name, sorted(labels))`` —
+  ``inc("faults.fired", point="serving.conn_drop")`` and
+  ``observe("frontend.request_ms", dt, route="/predict")`` create
+  distinct series, rendered as ``name{k=v,...}`` in snapshots and as
+  real Prometheus labels in the exposition.
+- **Fixed-bucket histograms.**  Latency/size distributions use fixed
+  bucket edges (Prometheus ``le`` semantics: bucket *i* counts values
+  ``<= edges[i]``, plus a +Inf overflow), so p50/p99 come from bucket
+  interpolation with zero per-observation allocation.
+- **Three read paths.**  ``snapshot()`` for programmatic reads (tests,
+  bench records), ``export_jsonl()`` for append-only trajectory files,
+  ``prometheus()`` for the HTTP frontend's ``GET /metrics`` scrape
+  endpoint (text exposition format 0.0.4).
+
+One process-global instance (``get_registry()``) serves the default
+wiring; components accept an explicit registry for isolation.
+``reset()`` zeroes values **in place** so long-lived handles held by a
+running server stay valid across test boundaries.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Default latency bucket edges, in milliseconds: 100 µs to 10 s.
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+#: Default size bucket edges (batch sizes, queue depths, row counts).
+SIZE_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _series_name(name: str, labels: _LabelKey) -> str:
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+class Counter:
+    """Monotonic counter.  ``inc()`` only goes up; ``reset()`` (via the
+    registry) zeroes it for test isolation."""
+
+    __slots__ = ("name", "labels", "_lock", "value", "_registry")
+
+    def __init__(self, name: str, labels: _LabelKey, registry:
+                 "MetricsRegistry"):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self.value = 0
+        self._registry = registry
+
+    def inc(self, value: float = 1) -> None:
+        if not self._registry.enabled:
+            return
+        if value < 0:
+            raise ValueError(f"counter {self.name} cannot decrease "
+                             f"(inc by {value})")
+        with self._lock:
+            self.value += value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.value = 0
+
+    def _snapshot(self) -> Any:
+        with self._lock:
+            return self.value
+
+
+class Gauge:
+    """Point-in-time value with a high-water mark (``max``) — queue
+    depths, in-flight request counts.  ``add()`` for up/down deltas."""
+
+    __slots__ = ("name", "labels", "_lock", "value", "max", "_registry")
+
+    def __init__(self, name: str, labels: _LabelKey,
+                 registry: "MetricsRegistry"):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self.value = 0.0
+        self.max = 0.0
+        self._registry = registry
+
+    def set(self, value: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self.value = value
+            if value > self.max:
+                self.max = value
+
+    def add(self, delta: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self.value += delta
+            if self.value > self.max:
+                self.max = self.value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.value = 0.0
+            self.max = 0.0
+
+    def _snapshot(self) -> Any:
+        with self._lock:
+            return {"value": self.value, "max": self.max}
+
+
+class Histogram:
+    """Fixed-bucket distribution (Prometheus ``le`` semantics): bucket
+    ``i`` counts observations ``<= edges[i]``; one overflow bucket
+    (+Inf) catches the rest.  Quantiles are linear interpolation within
+    the winning bucket — exact enough for p50/p99 dashboards, free of
+    per-observation allocation."""
+
+    __slots__ = ("name", "labels", "edges", "_lock", "counts", "sum",
+                 "count", "_registry")
+
+    def __init__(self, name: str, labels: _LabelKey,
+                 registry: "MetricsRegistry",
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.labels = labels
+        self.edges = tuple(float(b) for b in (buckets or LATENCY_BUCKETS_MS))
+        if list(self.edges) != sorted(set(self.edges)):
+            raise ValueError(f"histogram {name} bucket edges must be "
+                             f"strictly increasing, got {self.edges}")
+        self._lock = threading.Lock()
+        self.counts = [0] * (len(self.edges) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._registry = registry
+
+    def observe(self, value: float) -> None:
+        if not self._registry.enabled:
+            return
+        i = bisect.bisect_left(self.edges, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile (q in [0, 1]) from bucket counts."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            if seen + c >= target and c > 0:
+                lo = self.edges[i - 1] if i > 0 else 0.0
+                hi = self.edges[i] if i < len(self.edges) else self.edges[-1]
+                frac = (target - seen) / c
+                return lo + frac * (hi - lo)
+            seen += c
+        return self.edges[-1]
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.counts = [0] * (len(self.edges) + 1)
+            self.sum = 0.0
+            self.count = 0
+
+    def _snapshot(self) -> Any:
+        with self._lock:
+            count, total = self.count, self.sum
+        return {"count": count, "sum": round(total, 6),
+                "mean": round(total / count, 6) if count else 0.0,
+                "p50": round(self.percentile(0.50), 6),
+                "p99": round(self.percentile(0.99), 6)}
+
+
+class MetricsRegistry:
+    """Thread-safe registry of named metric series.
+
+    Get-or-create handles (``counter``/``gauge``/``histogram``) for hot
+    paths; one-shot ``inc``/``observe``/``set_gauge`` for cold ones.
+    Creating the same ``(name, labels)`` under a different metric type
+    raises — a name means one thing everywhere."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, _LabelKey], Any] = {}
+        self._types: Dict[str, type] = {}  # name → metric class
+        self.enabled = True
+
+    # -- handle creation ------------------------------------------------------
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kw: Any):
+        key = (name, _label_key(labels))
+        with self._lock:
+            # type uniqueness is per NAME, not per (name, labels): the
+            # exposition renders all of a name's label series under one
+            # # TYPE line, so a counter and a histogram sharing a name
+            # (differing only in labels) would corrupt the scrape
+            known = self._types.get(name)
+            if known is not None and known is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{known.__name__}, not {cls.__name__}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[1], self, **kw)
+                self._metrics[key] = m
+                self._types[name] = cls
+            return m
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None,
+                  **labels: Any) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    # -- one-shot writes ------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1, **labels: Any) -> None:
+        self.counter(name, **labels).inc(value)
+
+    def set_gauge(self, name: str, value: float, **labels: Any) -> None:
+        self.gauge(name, **labels).set(value)
+
+    def observe(self, name: str, value: float,
+                buckets: Optional[Tuple[float, ...]] = None,
+                **labels: Any) -> None:
+        self.histogram(name, buckets=buckets, **labels).observe(value)
+
+    # -- reads ----------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{series: value} over every registered series.  Counters are
+        numbers, gauges ``{"value", "max"}``, histograms
+        ``{"count", "sum", "mean", "p50", "p99"}``."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {_series_name(name, labels): m._snapshot()
+                for (name, labels), m in sorted(items, key=lambda kv:
+                                                _series_name(*kv[0]))}
+
+    def flat(self, prefix: str = "") -> Dict[str, float]:
+        """Back-compat flat view: counters and gauge values only, as
+        plain numbers (the shape the old ad-hoc stats dicts had).
+        ``prefix`` filters to series whose name starts with it, and is
+        stripped from the keys."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for (name, labels), m in items:
+            if not name.startswith(prefix):
+                continue
+            series = _series_name(name[len(prefix):], labels)
+            if isinstance(m, Counter):
+                out[series] = m._snapshot()
+            elif isinstance(m, Gauge):
+                out[series] = m._snapshot()["value"]
+        return out
+
+    def prometheus(self) -> str:
+        """Text exposition format 0.0.4 — what ``GET /metrics`` serves.
+        Dots in metric names become underscores under a ``zoo_`` prefix
+        (Prometheus names admit ``[a-zA-Z0-9_:]`` only)."""
+        by_name: Dict[str, List[Tuple[_LabelKey, Any]]] = {}
+        with self._lock:
+            for (name, labels), m in self._metrics.items():
+                by_name.setdefault(name, []).append((labels, m))
+        lines: List[str] = []
+        for name in sorted(by_name):
+            prom = "zoo_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name)
+            series = by_name[name]
+            kind = series[0][1]
+            if isinstance(kind, Counter):
+                lines.append(f"# TYPE {prom} counter")
+                for labels, m in sorted(series, key=lambda s: s[0]):
+                    lines.append(f"{prom}{_prom_labels(labels)} "
+                                 f"{_prom_num(m._snapshot())}")
+            elif isinstance(kind, Gauge):
+                lines.append(f"# TYPE {prom} gauge")
+                for labels, m in sorted(series, key=lambda s: s[0]):
+                    snap = m._snapshot()
+                    lines.append(f"{prom}{_prom_labels(labels)} "
+                                 f"{_prom_num(snap['value'])}")
+                    lines.append(f"{prom}_max{_prom_labels(labels)} "
+                                 f"{_prom_num(snap['max'])}")
+            else:
+                lines.append(f"# TYPE {prom} histogram")
+                for labels, m in sorted(series, key=lambda s: s[0]):
+                    with m._lock:
+                        counts = list(m.counts)
+                        total, count = m.sum, m.count
+                    cum = 0
+                    for edge, c in zip(m.edges, counts):
+                        cum += c
+                        lab = _prom_labels(labels, le=_prom_num(edge))
+                        lines.append(f"{prom}_bucket{lab} {cum}")
+                    lab = _prom_labels(labels, le="+Inf")
+                    lines.append(f"{prom}_bucket{lab} {count}")
+                    lines.append(f"{prom}_sum{_prom_labels(labels)} "
+                                 f"{_prom_num(total)}")
+                    lines.append(f"{prom}_count{_prom_labels(labels)} "
+                                 f"{count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def export_jsonl(self, path: str) -> None:
+        """Append one ``{"wall": ..., "metrics": snapshot()}`` line —
+        the trajectory-file format ``metrics.jsonl`` readers parse."""
+        rec = {"wall": time.time(), "metrics": self.snapshot()}
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Zero every series IN PLACE: handles cached by long-lived
+        components (a running server's counters) stay registered and
+        valid; only the values clear.  Test-boundary hygiene."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _prom_labels(labels: _LabelKey, **extra: str) -> str:
+    pairs = [(k, v) for k, v in labels] + sorted(extra.items())
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry — the default wiring of every
+    instrumented component in the framework."""
+    return _REGISTRY

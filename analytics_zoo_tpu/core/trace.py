@@ -1,0 +1,118 @@
+"""Lightweight end-to-end request tracing for the serving path.
+
+Answers "where did this request's latency go?": the client stamps every
+request frame with a short trace id (``trace`` in the frame header), the
+id rides the wire through frontend → server conn loop → batcher →
+inference → reply, and each hop reports its stage timings — the server
+returns its per-stage breakdown (queue wait, inference time, realized
+batch size) IN the reply header, and both sides record a
+:class:`TraceRecord` into a process-wide ring buffer so tests and debug
+tooling can correlate the same id across components.
+
+Not a distributed tracer: no sampling, no spans-over-RPC, no clock-sync
+assumptions (all durations are measured locally with ``time.monotonic``
+and shipped as numbers, never as timestamps).  Just enough structure
+that a slow request logs one line with a correlatable id and a stage
+breakdown instead of an anonymous timeout.
+
+Usage::
+
+    uid = input_queue.enqueue("app", t=arr)      # trace id auto-stamped
+    out = output_queue.query(uid)
+    tid = input_queue.trace_id(uid)              # the id that rode the wire
+    for rec in trace.find(tid):                  # client + server records
+        print(rec.where, rec.stages)
+
+Requests slower than ``SLOW_MS`` (module attribute, default 1000 ms) are
+logged at WARNING with their trace id and stage breakdown.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: Requests whose client-observed total exceeds this many milliseconds
+#: are logged at WARNING with their trace id + stage breakdown.
+SLOW_MS = 1000.0
+
+#: How many completed trace records the ring buffer keeps.
+MAX_RECORDS = 512
+
+
+def new_trace_id() -> str:
+    """16 hex chars — short enough for log lines, unique enough for a
+    process's ring buffer."""
+    return uuid.uuid4().hex[:16]
+
+
+class TraceRecord:
+    """One component's view of one traced request: ``where`` names the
+    component ("client", "server.batch", "frontend"), ``stages`` maps
+    stage name → milliseconds."""
+
+    __slots__ = ("trace_id", "where", "stages", "wall")
+
+    def __init__(self, trace_id: str, where: str,
+                 stages: Dict[str, float]):
+        self.trace_id = trace_id
+        self.where = where
+        self.stages = dict(stages)
+        self.wall = time.time()
+
+    def __repr__(self) -> str:
+        return (f"TraceRecord({self.trace_id}, {self.where}, "
+                f"{self.stages})")
+
+
+_lock = threading.Lock()
+_records: "collections.deque[TraceRecord]" = collections.deque(
+    maxlen=MAX_RECORDS)
+
+
+def record(trace_id: Optional[str], where: str,
+           stages: Dict[str, float]) -> Optional[TraceRecord]:
+    """Record one component's stage breakdown for ``trace_id``.  A None
+    id (an untraced legacy request) is a no-op, so call sites never need
+    to branch."""
+    if trace_id is None:
+        return None
+    rec = TraceRecord(trace_id, where, stages)
+    with _lock:
+        _records.append(rec)
+    return rec
+
+
+def find(trace_id: str) -> List[TraceRecord]:
+    """Every recorded view of ``trace_id``, in arrival order — for a
+    served request typically a ``server.batch`` record then a ``client``
+    record whose stages embed the server breakdown."""
+    with _lock:
+        return [r for r in _records if r.trace_id == trace_id]
+
+
+def recent(n: Optional[int] = None) -> List[TraceRecord]:
+    with _lock:
+        out = list(_records)
+    return out if n is None else out[-n:]
+
+
+def reset() -> None:
+    with _lock:
+        _records.clear()
+
+
+def maybe_log_slow(trace_id: Optional[str], what: str, total_ms: float,
+                   stages: Dict[str, float]) -> None:
+    """One WARNING line for a slow request, with the correlatable id."""
+    if total_ms < SLOW_MS:
+        return
+    breakdown = ", ".join(f"{k}={v:.1f}ms" for k, v in stages.items())
+    logger.warning("slow request %s (trace %s): %.1f ms total [%s]",
+                   what, trace_id or "-", total_ms, breakdown)

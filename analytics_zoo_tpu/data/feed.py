@@ -16,12 +16,14 @@ Static shapes: batches are fixed-size (remainder dropped or padded) so the
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Iterator, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from analytics_zoo_tpu.core import metrics as _metrics_lib
 from analytics_zoo_tpu.core.faults import get_registry as _fault_registry
 from .shards import XShards
 
@@ -232,11 +234,18 @@ class DataFeed(FeedBase):
         """Yield mesh-sharded batches for one epoch (one-batch lookahead)."""
         idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
+        # batch-assembly latency (slice + stack + device_put dispatch):
+        # the host-side cost the one-batch lookahead hides from training
+        m_assemble = _metrics_lib.get_registry().histogram(
+            "feed.batch_assembly_ms")
 
         def host_batch(step: int) -> Dict[str, np.ndarray]:
+            t0 = time.monotonic()
             sel = self._batch_index(idx, step)
-            return jax.tree_util.tree_map(
+            out = jax.tree_util.tree_map(
                 lambda a: _take(a, sel), self._data)
+            m_assemble.observe((time.monotonic() - t0) * 1000.0)
+            return out
 
         pending = shard_batch(host_batch(0), mesh)
         for step in range(steps):

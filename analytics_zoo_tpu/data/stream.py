@@ -33,11 +33,13 @@ takes either interchangeably.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 from jax.sharding import Mesh
 
+from analytics_zoo_tpu.core import metrics as metrics_lib
 from analytics_zoo_tpu.native import NativeQueue
 from .feed import FeedBase, shard_batch
 
@@ -82,6 +84,14 @@ class StreamingDataFeed(FeedBase):
         self.skipped_rows = 0    # rows substituted because their sample
         #                          never loaded (on_error="skip")
         self.load_failures = 0   # loader exceptions seen (incl. retried)
+        # telemetry (core/metrics.py): per-sample load latency + the
+        # resilience counters mirrored process-wide, so "is the input
+        # pipeline degrading?" is answerable without holding the feed
+        reg = metrics_lib.get_registry()
+        self._m_load = reg.histogram("feed.load_ms")
+        self._m_failures = reg.counter("feed.load_failures")
+        self._m_retries = reg.counter("feed.retries")
+        self._m_skipped = reg.counter("feed.skipped_rows")
 
     # -- resilient sample loading --------------------------------------------
 
@@ -100,14 +110,20 @@ class StreamingDataFeed(FeedBase):
         last: Optional[BaseException] = None
         for _attempt in range(self.retries + 1):
             try:
+                if _attempt:
+                    self._m_retries.inc()
                 if inject:
                     self._fault_registry().raise_if("feed.read_fail",
                                                     OSError)
-                return self._load(i, rng=rng)
+                t0 = time.monotonic()
+                out = self._load(i, rng=rng)
+                self._m_load.observe((time.monotonic() - t0) * 1000.0)
+                return out
             except Exception as e:  # noqa: BLE001 — loader bugs vary freely
                 last = e
                 with self._counter_lock:
                     self.load_failures += 1
+                self._m_failures.inc()
         assert last is not None
         raise last
 
@@ -121,6 +137,7 @@ class StreamingDataFeed(FeedBase):
             with self._counter_lock:
                 self.skipped_rows += 1
                 skipped = self.skipped_rows
+            self._m_skipped.inc()
             if self.max_skipped is not None and skipped > self.max_skipped:
                 raise RuntimeError(
                     f"streaming feed skipped {skipped} rows "

@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from analytics_zoo_tpu.core import checkpoint as ckpt_io
 from analytics_zoo_tpu.core import get_mesh
 from analytics_zoo_tpu.core import faults as faults_lib
+from analytics_zoo_tpu.core import metrics as telemetry
 from analytics_zoo_tpu.core.context import heartbeat
 from analytics_zoo_tpu.core.summary import SummaryWriter
 from analytics_zoo_tpu.data import as_feed, batch_sharding, shard_batch
@@ -486,6 +487,21 @@ class ZooEstimator:
         target_epoch = self._epoch + epochs
         faults = faults_lib.get_registry()
         host_nan_check = self.nan_policy in ("warn", "rollback", "raise")
+        # step-loop telemetry (core/metrics.py): handles hoisted out of
+        # the loop so the per-step cost is two monotonic reads and two
+        # histogram observes.  ``train.data_wait_ms`` is the time this
+        # loop spent blocked on the feed (input-bound signal);
+        # ``train.step_ms`` is the full iteration wall — under async
+        # dispatch the device compute of step N overlaps the host work of
+        # step N+1, so the split is "host waited on data" vs "everything
+        # else", and a rising data fraction means the input pipeline, not
+        # the TPU, is the bottleneck.
+        reg = telemetry.get_registry()
+        m_step = reg.histogram("train.step_ms")
+        m_wait = reg.histogram("train.data_wait_ms")
+        m_steps = reg.counter("train.steps")
+        m_samples = reg.counter("train.samples")
+        m_bad = reg.counter("train.bad_steps")
 
         if self._preempt is not None:
             self._preempt.active = True
@@ -499,9 +515,18 @@ class ZooEstimator:
                 # produce negative or wildly wrong throughput numbers
                 t0 = time.monotonic()
                 losses = []
+                epoch_wait = 0.0
                 bad_before = self.bad_steps
                 rolled_back = False
-                for batch in feed.epoch(mesh, self._epoch):
+                batch_iter = iter(feed.epoch(mesh, self._epoch))
+                while True:
+                    t_fetch = time.monotonic()
+                    batch = next(batch_iter, None)
+                    if batch is None:
+                        break
+                    wait = time.monotonic() - t_fetch
+                    epoch_wait += wait
+                    m_wait.observe(wait * 1000.0)
                     if "mask" in batch:
                         # a padded final batch from a stream feed: training
                         # on it would weight the duplicated pad rows fully
@@ -514,8 +539,10 @@ class ZooEstimator:
                         self._ensure_initialized(batch["x"])
                         first = False
                     # liveness beat for the zoo-launch gang supervisor
-                    # (no-op unless a heartbeat file is configured)
-                    heartbeat()
+                    # (no-op unless a heartbeat file is configured); the
+                    # payload makes the heartbeat file a tiny status
+                    # report the supervisor can aggregate
+                    heartbeat(step=self._py_step)
                     # worker fault seams (core/faults.py): a hard worker
                     # death and a wedged step, both disarmed no-ops in
                     # production and armed by gang-supervision tests
@@ -532,9 +559,13 @@ class ZooEstimator:
                     # track the step in Python: reading self._ts["step"]
                     # would force a device sync on every iteration
                     self._py_step += 1
+                    m_step.observe((time.monotonic() - t_fetch) * 1000.0)
+                    m_steps.inc()
+                    m_samples.inc(feed.global_batch)
                     if host_nan_check and not math.isfinite(
                             float(loss_val)):
                         self.bad_steps += 1
+                        m_bad.inc()
                         if self.nan_policy == "raise":
                             self._stop_profile()
                             raise NonFiniteLossError(self._py_step)
@@ -580,6 +611,10 @@ class ZooEstimator:
                 if self.nan_policy == "skip_step":
                     epoch_loss = float(jnp.nanmean(stacked))
                     self.bad_steps = int(self._ts["bad_steps"])
+                    if self.bad_steps > bad_before:
+                        # the in-jit guard counted on device; sync the
+                        # registry mirror once per epoch
+                        m_bad.inc(self.bad_steps - bad_before)
                 else:
                     epoch_loss = float(stacked.mean())
                 history["loss"].append(epoch_loss)
@@ -588,9 +623,27 @@ class ZooEstimator:
                         self.bad_steps - bad_before)
                 dt = time.monotonic() - t0
                 n = len(losses) * feed.global_batch
+                # epoch-granularity telemetry mirror: the same numbers
+                # land in the registry (histograms above) AND the
+                # SummaryWriter scalars, so both snapshot() and
+                # TensorBoard answer "is the loop data-bound?"
+                step_ms = 1000.0 * dt / len(losses)
+                wait_ms = 1000.0 * epoch_wait / len(losses)
+                compute_ms = max(0.0, step_ms - wait_ms)
+                samples_per_sec = n / dt
+                heartbeat(force=True, step=self._py_step, loss=epoch_loss,
+                          samples_per_sec=round(samples_per_sec, 2))
                 if self._writer:
                     self._writer.add_scalar("loss", epoch_loss, self._epoch)
                     self._writer.add_scalar("throughput", n / dt,
+                                            self._epoch)
+                    self._writer.add_scalar("samples_per_sec",
+                                            samples_per_sec, self._epoch)
+                    self._writer.add_scalar("step_time_ms", step_ms,
+                                            self._epoch)
+                    self._writer.add_scalar("data_wait_ms", wait_ms,
+                                            self._epoch)
+                    self._writer.add_scalar("compute_ms", compute_ms,
                                             self._epoch)
                     if self.nan_policy is not None:
                         self._writer.add_scalar(

@@ -31,6 +31,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.core import metrics as metrics_lib
+from analytics_zoo_tpu.core import trace as trace_lib
 from . import protocol
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -84,24 +86,44 @@ class _Conn:
     MAX_INFLIGHT_BYTES = 64 * 1024 * 1024
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
         self.host, self.port = host, port
         self.connect_timeout = timeout
         self.retry = retry or RetryPolicy()
         # insertion-ordered (dicts are), so eviction drops the oldest
-        self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str]]]
+        self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str],
+                                       Optional[Dict]]]
         self._results = {}
         self._inflight: Dict[str, bytes] = {}  # uuid -> encoded frame
         self._inflight_bytes = 0
+        # uuid -> (trace id, enqueue time.monotonic): the client half of
+        # the end-to-end trace (core/trace.py)
+        self._traces: Dict[str, Tuple[str, float]] = {}
         self._generation = 0  # bumped per successful (re)connect
         self._cond = threading.Condition()
         self._send_lock = threading.Lock()
         self._conn_lock = threading.Lock()  # serializes reconnects
         self._closed = False
         self.stats = {"reconnects": 0, "resends": 0, "retries": 0}
+        self._metrics = metrics or metrics_lib.get_registry()
+        self._m_request = self._metrics.histogram("client.request_ms")
         self.sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._connect()
+
+    def _bump(self, key: str) -> None:
+        """One resilience event: the legacy ``stats`` dict AND the
+        process registry (``client.<key>``) move together."""
+        self.stats[key] += 1
+        self._metrics.inc("client." + key)
+
+    def trace_id(self, uid: str) -> Optional[str]:
+        """The trace id stamped on request ``uid`` (None once the
+        request is forgotten or was never traced)."""
+        with self._cond:
+            info = self._traces.get(uid)
+        return info[0] if info else None
 
     # -- connection lifecycle --------------------------------------------------
 
@@ -130,7 +152,8 @@ class _Conn:
                 header, arr = protocol.decode(frame)
                 with self._cond:
                     self._results[header["uuid"]] = (arr,
-                                                     header.get("error"))
+                                                     header.get("error"),
+                                                     header.get("stages"))
                     while len(self._results) > self.MAX_UNCLAIMED:
                         self._results.pop(next(iter(self._results)))
                     self._cond.notify_all()
@@ -161,7 +184,7 @@ class _Conn:
                     pass
                 try:
                     self._connect()
-                    self.stats["reconnects"] += 1
+                    self._bump("reconnects")
                     logger.debug("reconnected to %s:%d (attempt %d)",
                                  self.host, self.port, attempt)
                     self._replay_inflight()
@@ -187,7 +210,7 @@ class _Conn:
             try:
                 with self._send_lock:
                     protocol.send_frame(self.sock, frame)
-                self.stats["resends"] += 1
+                self._bump("resends")
             except OSError:
                 return  # died again: the next liveness check handles it
 
@@ -208,10 +231,14 @@ class _Conn:
         with self._cond:
             self._inflight[uid] = frame
             self._inflight_bytes += len(frame)
+            if header.get("trace") is not None:
+                self._traces[uid] = (header["trace"], time.monotonic())
             while (len(self._inflight) > self.MAX_INFLIGHT
                    or self._inflight_bytes > self.MAX_INFLIGHT_BYTES):
-                dropped = self._inflight.pop(next(iter(self._inflight)))
+                evicted = next(iter(self._inflight))
+                dropped = self._inflight.pop(evicted)
                 self._inflight_bytes -= len(dropped)
+                self._traces.pop(evicted, None)
         self._send_frame_with_retry(uid, frame)
 
     def resend(self, uid: str) -> bool:
@@ -228,7 +255,7 @@ class _Conn:
                 uid)
             return False
         if self._send_frame_with_retry(uid, frame):
-            self.stats["resends"] += 1  # replay-carried sends count there
+            self._bump("resends")  # replay-carried sends count there
         return True
 
     def _send_frame_with_retry(self, uid: str, frame: bytes) -> bool:
@@ -251,7 +278,7 @@ class _Conn:
                 return True
             except OSError as e:
                 last = e
-                self.stats["retries"] += 1
+                self._bump("retries")
                 if attempt < self.retry.max_attempts:
                     time.sleep(self.retry.delay(attempt))
         raise OSError(f"send failed after {self.retry.max_attempts} "
@@ -274,12 +301,15 @@ class _Conn:
         with self._cond:
             return self._results.pop(uid, None)
 
-    def forget(self, uid: str) -> None:
-        """Drop the resend record (request answered, or caller gave up)."""
+    def forget(self, uid: str) -> Optional[Tuple[str, float]]:
+        """Drop the resend record (request answered, or caller gave up).
+        Returns the (trace id, enqueue time) pair for the request, so
+        the caller can close out its trace."""
         with self._cond:
             frame = self._inflight.pop(uid, None)
             if frame is not None:
                 self._inflight_bytes -= len(frame)
+            return self._traces.pop(uid, None)
 
 
 class InputQueue:
@@ -287,13 +317,15 @@ class InputQueue:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8980,
                  frontend_url: Optional[str] = None,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
         if frontend_url:  # "host:port" parity with the reference's url conf
             host, port_s = frontend_url.rsplit(":", 1)
             port = int(port_s)
-        self._conn = _Conn(host, port, retry=retry)
+        self._conn = _Conn(host, port, retry=retry, metrics=metrics)
 
     def enqueue(self, name: str, deadline: Optional[float] = None,
+                trace_id: Optional[str] = None,
                 **kwargs: np.ndarray) -> str:
         """Send one named tensor; returns the uuid to ``query`` on.
 
@@ -302,17 +334,28 @@ class InputQueue:
         sheds the request (error reply "deadline exceeded") instead of
         running inference once the budget is spent.  Retries restamp the
         full budget — the server re-anchors it at arrival, so clocks never
-        need to agree across hosts."""
+        need to agree across hosts.
+
+        ``trace_id``: the end-to-end trace id for this request
+        (core/trace.py); auto-generated when omitted, pass one to join
+        an existing trace (the HTTP frontend propagates the caller's
+        ``X-Trace-Id`` this way).  Read it back with ``trace_id(uid)``."""
         if len(kwargs) != 1:
             raise ValueError("exactly one named tensor per enqueue "
                              "(reference: t=ndarray)")
         (_, arr), = kwargs.items()
         uid = f"{name}-{uuid_mod.uuid4()}"
-        header: Dict = {"uuid": uid}
+        header: Dict = {"uuid": uid,
+                        "trace": trace_id or trace_lib.new_trace_id()}
         if deadline is not None:
             header["deadline_ms"] = max(1, int(deadline * 1000))
         self._conn.send_request(header, np.asarray(arr))
         return uid
+
+    def trace_id(self, uid: str) -> Optional[str]:
+        """The trace id riding request ``uid``'s frame header (None once
+        the request has been answered and forgotten)."""
+        return self._conn.trace_id(uid)
 
     def close(self) -> None:
         self._conn.close()
@@ -330,11 +373,12 @@ class OutputQueue:
 
     def __init__(self, input_queue: Optional[InputQueue] = None,
                  host: str = "127.0.0.1", port: int = 8980,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
         if input_queue is not None:
             self._conn = input_queue.conn
         else:
-            self._conn = _Conn(host, port, retry=retry)
+            self._conn = _Conn(host, port, retry=retry, metrics=metrics)
 
     def query(self, uid: str, timeout: Optional[float] = 30.0
               ) -> Optional[np.ndarray]:
@@ -354,6 +398,7 @@ class OutputQueue:
                     else deadline - time.monotonic())
             if left is not None and left <= 0:
                 conn.forget(uid)
+                conn._metrics.inc("client.timeouts")
                 return None
             # wait in slices so a dead reader is noticed promptly even
             # when the reply will never come
@@ -368,14 +413,26 @@ class OutputQueue:
                         conn.forget(uid)
                         raise
                 continue
-            arr, err = res
+            arr, err, stages = res
             if err is None:
-                conn.forget(uid)
+                info = conn.forget(uid)
+                if info is not None:
+                    # close out the end-to-end trace: client-observed
+                    # total + the server's per-stage breakdown from the
+                    # reply header, one record, one correlatable id
+                    tid, t0 = info
+                    total = (time.monotonic() - t0) * 1000.0
+                    all_stages = {"client.total_ms": round(total, 3)}
+                    if stages:
+                        all_stages.update(stages)
+                    conn._m_request.observe(total)
+                    trace_lib.record(tid, "client", all_stages)
+                    trace_lib.maybe_log_slow(tid, uid, total, all_stages)
                 return arr
             if (any(m in err for m in RETRYABLE_ERRORS)
                     and error_retries + 1 < conn.retry.max_attempts):
                 error_retries += 1
-                conn.stats["retries"] += 1
+                conn._bump("retries")
                 # never sleep past the caller's deadline: cap the backoff
                 # at the remaining budget (the loop top then times out)
                 delay = conn.retry.delay(error_retries)

@@ -16,8 +16,25 @@ Endpoints (TF-Serving-flavored JSON):
                    "deadline_ms": <int>?}
                   → {"predictions": <nested list>}
   GET  /health    → {"status": "ok"}
-  GET  /stats     → request/error/timeout counters + the backend
-                    connection's reconnect/resend/retry counters
+  GET  /stats     → namespaced counters: ``frontend.*`` (this gateway),
+                    ``client.*`` (the resilient backend connection) and
+                    ``frontend.request_ms.*`` route-latency summaries,
+                    PLUS a flat back-compat view (the pre-registry key
+                    names: ``requests``, ``timeouts``, ``reconnects``,
+                    ...).  The flat view exists because the old code
+                    merged ``conn.stats`` into its own dict with
+                    ``dict.update`` — same-named keys silently clobbered
+                    each other; the namespaced keys are the fix, the
+                    flat keys keep old dashboards alive.
+  GET  /metrics   → Prometheus text exposition (format 0.0.4) of the
+                    whole process registry — serving ``server.*``,
+                    ``client.*`` and ``frontend.*`` series in one scrape.
+
+Observability: every route's latency lands in the
+``frontend.request_ms{route=...}`` histogram; ``/predict`` accepts an
+``X-Trace-Id`` header (one is generated when absent), propagates it down
+the serving frame so the backend's per-stage breakdown correlates, and
+echoes it back on the response.
 
 Failure semantics: a per-request deadline (``deadline_ms`` in the JSON
 body, or the ``X-Deadline-Ms`` header) is propagated to the serving
@@ -32,14 +49,22 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.core import metrics as metrics_lib
+from analytics_zoo_tpu.core import trace as trace_lib
 from .client import InputQueue, OutputQueue
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+#: The frontend's own counters (the old ad-hoc ``_stats`` dict keys, now
+#: ``frontend.<key>`` series in the process registry).
+_FRONTEND_COUNTERS = ("requests", "errors", "timeouts",
+                      "deadline_exceeded", "rejected")
 
 
 class HTTPFrontend:
@@ -47,45 +72,90 @@ class HTTPFrontend:
 
     def __init__(self, serving_host: str = "127.0.0.1",
                  serving_port: int = 8980, host: str = "127.0.0.1",
-                 port: int = 0, query_timeout: float = 30.0):
+                 port: int = 0, query_timeout: float = 30.0,
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
         self._serving_addr = (serving_host, serving_port)
-        self._connect()
+        self._metrics = metrics or metrics_lib.get_registry()
+        self._connect()  # after _metrics: the backend conn reports to it
         self.query_timeout = query_timeout
-        self._stats_lock = threading.Lock()
-        self._stats = {"requests": 0, "errors": 0, "timeouts": 0,
-                       "deadline_exceeded": 0, "rejected": 0}
+        # handle-per-counter: the old dict + lock, now shared with every
+        # other telemetry consumer (snapshot / Prometheus / JSONL)
+        self._counters = {k: self._metrics.counter("frontend." + k)
+                          for k in _FRONTEND_COUNTERS}
+        # per-route latency histogram handles, cached so the per-request
+        # cost is a dict hit, not a registry name lookup (routes are a
+        # small closed set: the four GET paths, /predict, "other")
+        self._route_hists: dict = {}
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route to our logger
                 logger.debug("http: " + fmt, *args)
 
-            def _json(self, code: int, payload) -> None:
+            def _json(self, code: int, payload,
+                      trace_id: Optional[str] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if trace_id:
+                    self.send_header("X-Trace-Id", trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, code: int, body: str, content_type: str
+                      ) -> None:
+                raw = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
             def do_GET(self):
-                if self.path in ("/", "/health"):
-                    self._json(200, {"status": "ok"})
-                elif self.path == "/stats":
-                    with frontend._stats_lock:  # copy only; write outside
-                        snapshot = dict(frontend._stats)
-                    # the resilient client's counters: how hard the
-                    # frontend is working to keep its backend connection
-                    snapshot.update(frontend._in.conn.stats)
-                    self._json(200, snapshot)
-                else:
-                    self._json(404, {"error": f"no route {self.path}"})
+                t0 = time.monotonic()
+                route = self.path if self.path in (
+                    "/", "/health", "/stats", "/metrics") else "other"
+                try:
+                    if self.path in ("/", "/health"):
+                        self._json(200, {"status": "ok"})
+                    elif self.path == "/stats":
+                        self._json(200, frontend.stats())
+                    elif self.path == "/metrics":
+                        # Prometheus scrape: the whole process registry,
+                        # so one scrape covers serving + client +
+                        # frontend (+ training, when co-located)
+                        self._text(200, frontend._metrics.prometheus(),
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                    else:
+                        self._json(404,
+                                   {"error": f"no route {self.path}"})
+                finally:
+                    frontend._observe_route(
+                        route, (time.monotonic() - t0) * 1000.0)
 
             def do_POST(self):
+                t0 = time.monotonic()
+                route = ("/predict" if self.path == "/predict"
+                         else "other")  # don't pollute /predict latency
+                try:
+                    self._do_predict()
+                finally:
+                    frontend._observe_route(
+                        route, (time.monotonic() - t0) * 1000.0)
+
+            def _do_predict(self):
                 if self.path != "/predict":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 frontend._bump("requests")  # every attempt, not just 200s
+                # join the caller's trace or start one: the id rides the
+                # serving frame header end-to-end and comes back on the
+                # response, so a slow request is correlatable across the
+                # HTTP log, the serving server and the client breakdown
+                tid = (self.headers.get("X-Trace-Id")
+                       or trace_lib.new_trace_id())
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -97,58 +167,101 @@ class HTTPFrontend:
                                 if deadline_ms is not None else None)
                 except (KeyError, ValueError, TypeError) as e:
                     frontend._bump("errors")
-                    self._json(400, {"error": f"bad request: {e}"})
+                    self._json(400, {"error": f"bad request: {e}"},
+                               trace_id=tid)
                     return
                 try:
-                    out = frontend.predict(arr, deadline=deadline)
+                    out = frontend.predict(arr, deadline=deadline,
+                                           trace_id=tid)
                 except RuntimeError as e:  # serving-side error reply
                     if "deadline exceeded" in str(e):
                         frontend._bump("deadline_exceeded")
-                        self._json(504, {"error": str(e)})
+                        self._json(504, {"error": str(e)}, trace_id=tid)
                         return
                     if "queue full" in str(e):
                         frontend._bump("rejected")
-                        self._json(503, {"error": str(e)})
+                        self._json(503, {"error": str(e)}, trace_id=tid)
                         return
                     frontend._bump("errors")
-                    self._json(500, {"error": str(e)})
+                    self._json(500, {"error": str(e)}, trace_id=tid)
                     return
                 except OSError as e:  # backend unreachable even after retry
                     frontend._bump("errors")
-                    self._json(503, {"error": f"serving unreachable: {e}"})
+                    self._json(503, {"error": f"serving unreachable: {e}"},
+                               trace_id=tid)
                     return
                 if out is None:
                     frontend._bump("timeouts")
-                    self._json(504, {"error": "serving timed out"})
+                    self._json(504, {"error": "serving timed out"},
+                               trace_id=tid)
                     return
-                self._json(200, {"predictions": out.tolist()})
+                self._json(200, {"predictions": out.tolist()},
+                           trace_id=tid)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
     def _bump(self, key: str) -> None:
-        with self._stats_lock:
-            self._stats[key] += 1
+        self._counters[key].inc()
+
+    def _observe_route(self, route: str, ms: float) -> None:
+        h = self._route_hists.get(route)
+        if h is None:
+            h = self._metrics.histogram("frontend.request_ms", route=route)
+            self._route_hists[route] = h
+        h.observe(ms)
+
+    def stats(self) -> dict:
+        """The ``/stats`` payload: namespaced ``frontend.*`` /
+        ``client.*`` counters plus the flat back-compat view (old key
+        names, no prefix).  Namespacing fixes the key-collision bug
+        where ``dict.update(conn.stats)`` could silently clobber
+        same-named frontend keys."""
+        out: dict = {}
+        for key, c in self._counters.items():
+            out[f"frontend.{key}"] = c.value
+        for key, v in self._in.conn.stats.items():
+            out[f"client.{key}"] = v
+        # registry-only client series (e.g. client.timeouts, which has
+        # no conn.stats mirror) complete the namespaced view
+        for key, v in self._metrics.flat(prefix="client.").items():
+            out.setdefault(f"client.{key}", v)
+        snap = self._metrics.snapshot()
+        for series, val in snap.items():
+            if series.startswith("frontend.request_ms"):
+                out[series] = val
+        # flat view (back-compat): the pre-registry response shape —
+        # frontend keys first, then the resilient client's; the sets are
+        # disjoint today and the namespaced keys above are authoritative
+        for key, c in self._counters.items():
+            out[key] = c.value
+        out.update(self._in.conn.stats)
+        return out
 
     def _connect(self) -> None:
-        self._in = InputQueue(*self._serving_addr)
+        # the same registry this frontend serves at /metrics: client.*
+        # series from the backend connection must land in one scrape
+        self._in = InputQueue(*self._serving_addr, metrics=self._metrics)
         self._out = OutputQueue(input_queue=self._in)
 
     def predict(self, arr: np.ndarray,
-                deadline: Optional[float] = None) -> Optional[np.ndarray]:
+                deadline: Optional[float] = None,
+                trace_id: Optional[str] = None) -> Optional[np.ndarray]:
         """One request through the shared connection.  Reconnect-with-
         backoff, idempotent re-enqueue and retryable-error handling all
         live in the resilient client underneath (serving/client.py) — a
         backend restart surfaces here only as a slightly slower reply.
         ``deadline`` (seconds) rides to the server so an expired request
-        is shed instead of served."""
+        is shed instead of served; ``trace_id`` joins the request to an
+        existing end-to-end trace (core/trace.py)."""
         # wait a grace window past the deadline: the shed happens when the
         # batcher reaches the request, and its explicit "deadline exceeded"
         # reply beats an anonymous client-side timeout as the 504 reason
         timeout = (self.query_timeout if deadline is None
                    else min(self.query_timeout, deadline + 1.0))
-        uid = self._in.enqueue("http", deadline=deadline, t=arr)
+        uid = self._in.enqueue("http", deadline=deadline,
+                               trace_id=trace_id, t=arr)
         return self._out.query(uid, timeout=timeout)
 
     # -- lifecycle ------------------------------------------------------------

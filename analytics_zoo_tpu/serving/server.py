@@ -25,6 +25,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.core import metrics as metrics_lib
+from analytics_zoo_tpu.core import trace as trace_lib
 from analytics_zoo_tpu.core.faults import FaultRegistry, get_registry
 from analytics_zoo_tpu.native import NativeQueue
 from .inference_model import InferenceModel
@@ -34,10 +36,12 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 
 class _Pending:
-    __slots__ = ("uuid", "arr", "conn", "lock", "expires")
+    __slots__ = ("uuid", "arr", "conn", "lock", "expires", "trace",
+                 "enq_t")
 
     def __init__(self, uid: str, arr: np.ndarray, conn: socket.socket,
-                 lock: threading.Lock, expires: Optional[float] = None):
+                 lock: threading.Lock, expires: Optional[float] = None,
+                 trace: Optional[str] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
@@ -45,6 +49,10 @@ class _Pending:
         # absolute time.monotonic() deadline (from the client's
         # ``deadline_ms`` budget, re-anchored at arrival); None = no limit
         self.expires = expires
+        # trace id from the frame header (core/trace.py): rides every
+        # reply so the client can correlate its per-stage breakdown
+        self.trace = trace
+        self.enq_t = time.monotonic()  # arrival → batcher = queue wait
 
 
 class ClusterServing:
@@ -55,7 +63,8 @@ class ClusterServing:
                  port: int = 0, batch_size: int = 16,
                  batch_timeout_ms: int = 5, queue_items: int = 4096,
                  push_timeout: float = 5.0,
-                 faults: Optional[FaultRegistry] = None):
+                 faults: Optional[FaultRegistry] = None,
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
         self.model = model
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
@@ -75,14 +84,32 @@ class ClusterServing:
         self._threads_lock = threading.Lock()
         self._conns: set = set()  # open client sockets, for drain/close
         # observability (reference: the Flink job's metrics): monotonically
-        # increasing counters, read via stats().  Invariant on a healthy
-        # server: requests == replies + errors once in-flight work drains.
-        # errors subsumes rejected (queue full), shed (deadline exceeded)
-        # and drained (stop() replied "server shutting down").
+        # increasing counters, read via stats() and mirrored into the
+        # process telemetry registry under ``server.*`` (core/metrics.py).
+        # Invariant on a healthy server:
+        #   requests == replies + errors + pending
+        # from any client's point of view (counters bump before reply
+        # frames go out), hence requests == replies + errors once
+        # in-flight work drains (pending == 0).  errors subsumes rejected
+        # (queue full), shed (deadline exceeded) and drained (stop()
+        # replied "server shutting down").
         self._stats_lock = threading.Lock()
         self._counters = {"requests": 0, "replies": 0, "batches": 0,
                           "errors": 0, "batch_rows": 0, "rejected": 0,
-                          "shed": 0, "drained": 0}
+                          "shed": 0, "drained": 0, "shed_batches": 0}
+        self._metrics = metrics or metrics_lib.get_registry()
+        # handle-per-counter (not one-shot inc): _count runs on every
+        # request/reply, and a name lookup there would serialize all
+        # serving threads on the registry's global lock
+        self._m_counters = {k: self._metrics.counter("server." + k)
+                            for k in self._counters}
+        self._m_depth = self._metrics.gauge("server.queue_depth")
+        self._m_batch_size = self._metrics.histogram(
+            "server.batch_size", buckets=metrics_lib.SIZE_BUCKETS)
+        self._m_queue_wait = self._metrics.histogram("server.queue_wait_ms")
+        self._m_infer = self._metrics.histogram("server.inference_ms")
+        self._m_shed_per_batch = self._metrics.histogram(
+            "server.shed_per_batch", buckets=metrics_lib.SIZE_BUCKETS)
 
     def update_model(self, model: InferenceModel) -> None:
         """Hot-swap the serving model without dropping connections
@@ -95,18 +122,37 @@ class ClusterServing:
 
     def stats(self) -> Dict[str, Any]:
         """Service counters: requests seen, replies sent, batches run,
-        errors (any non-success reply), and the realized mean batch size
-        (micro-batching health)."""
+        errors (any non-success reply), ``shed_batches`` (batches that
+        shed at least one expired request — the per-batch shed signal
+        that a cumulative ``shed`` count loses between polls), the
+        realized mean batch size (micro-batching health), plus queue
+        health: ``pending`` (in-flight right now), ``queue_depth``
+        (native-queue occupancy) and ``queue_depth_max`` (high-water
+        mark since start).
+
+        Healthy-server invariant, asserted by the observability tests:
+        ``requests == replies + errors + pending`` — every request seen
+        is either answered (reply or error) or still in flight; nothing
+        is silently dropped.  Counters are bumped BEFORE the reply frame
+        is sent, so the invariant holds from any client's point of view
+        (a stats() poll racing a mid-batch request may transiently see
+        requests exceed the right-hand side while the batch runs)."""
         with self._stats_lock:
             c = dict(self._counters)
         c["mean_batch_size"] = (c.pop("batch_rows") / c["batches"]
                                 if c["batches"] else 0.0)
+        with self._pending_lock:
+            c["pending"] = len(self._pending)
+        c["queue_depth"] = self._m_depth.value
+        c["queue_depth_max"] = self._m_depth.max
         return c
 
     def _count(self, **deltas: int) -> None:
         with self._stats_lock:
             for k, v in deltas.items():
                 self._counters[k] += v  # unknown keys fail loudly
+        for k, v in deltas.items():  # registry mirror: server.* counters
+            self._m_counters[k].inc(v)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -155,13 +201,18 @@ class ClusterServing:
             if t.is_alive():
                 logger.warning("ClusterServing.stop: thread %s did not "
                                "exit within %.1fs", t.name, drain_timeout)
+        # requests still sitting in the closed queue will never be popped
+        # through _take: zero the occupancy gauge so a stopped server (or
+        # a successor sharing the process registry) reports no phantom
+        # queue depth; the high-water mark is preserved
+        self._m_depth.set(0.0)
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
         if pending:
             self._count(errors=len(pending), drained=len(pending))
             for p in pending:
-                self._reply(p, {"uuid": p.uuid,
+                self._reply(p, {"uuid": p.uuid, "trace": p.trace,
                                 "error": "server shutting down"}, None)
             logger.info("ClusterServing.stop: drained %d pending "
                         "request(s)", len(pending))
@@ -214,6 +265,7 @@ class ClusterServing:
                     return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())
+                tid = header.get("trace")
                 self._count(requests=1)
                 if arr is None:
                     # protocol-legal but not servable: a header-only frame
@@ -222,7 +274,8 @@ class ClusterServing:
                     self._count(errors=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
-                            {"uuid": uid, "error": "no tensor in request"}))
+                            {"uuid": uid, "trace": tid,
+                             "error": "no tensor in request"}))
                     continue
                 # deadline_ms is a RELATIVE budget re-anchored at arrival:
                 # client and server clocks never need to agree
@@ -233,17 +286,27 @@ class ClusterServing:
                     rid = self._next_id
                     self._next_id += 1
                     self._pending[rid] = _Pending(uid, arr, conn, send_lock,
-                                                  expires)
-                ok = (not self._faults.fire("serving.queue_reject")
-                      and self._queue.push(rid.to_bytes(8, "big"),
-                                           timeout=self.push_timeout))
+                                                  expires, trace=tid)
+                # occupancy BEFORE the push: the batcher may pop (and
+                # decrement) the instant push returns, and a +1 that
+                # lands after the -1 would miss the high-water mark
+                self._m_depth.add(1)
+                try:
+                    ok = (not self._faults.fire("serving.queue_reject")
+                          and self._queue.push(rid.to_bytes(8, "big"),
+                                               timeout=self.push_timeout))
+                except RuntimeError:  # queue closed: server is stopping
+                    self._m_depth.add(-1)
+                    raise
                 if not ok:  # back-pressure: reject instead of dropping
+                    self._m_depth.add(-1)  # never entered the queue
                     with self._pending_lock:
                         self._pending.pop(rid, None)
                     self._count(errors=1, rejected=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
-                            {"uuid": uid, "error": "queue full"}))
+                            {"uuid": uid, "trace": tid,
+                             "error": "queue full"}))
         except (OSError, ValueError) as e:
             logger.debug("connection closed: %s", e)
         except RuntimeError:
@@ -293,6 +356,7 @@ class ClusterServing:
 
     def _take(self, rid_bytes: bytes) -> Optional[_Pending]:
         rid = int.from_bytes(rid_bytes, "big")
+        self._m_depth.add(-1)  # popped from the native queue
         with self._pending_lock:
             return self._pending.pop(rid, None)
 
@@ -303,13 +367,24 @@ class ClusterServing:
         (the client's query raises instead of timing out)."""
         now = time.monotonic()
         live: List[_Pending] = []
+        expired: List[_Pending] = []
         for p in batch:
             if p.expires is not None and p.expires < now:
-                self._count(errors=1, shed=1)
-                self._reply(p, {"uuid": p.uuid,
-                                "error": "deadline exceeded"}, None)
+                expired.append(p)
             else:
                 live.append(p)
+        if expired:
+            # count FIRST, reply second: a client reacting to the shed
+            # reply must already see consistent counters in stats().
+            # shed_batches + the per-batch histogram record the shed
+            # DISTRIBUTION — a cumulative counter can't tell "one bad
+            # batch shed 30" from "30 batches shed 1 each".
+            self._count(errors=len(expired), shed=len(expired),
+                        shed_batches=1)
+            self._m_shed_per_batch.observe(len(expired))
+            for p in expired:
+                self._reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                "error": "deadline exceeded"}, None)
         return live
 
     def _run_batch(self, batch: List[_Pending]) -> None:
@@ -321,19 +396,42 @@ class ClusterServing:
         for p in batch:
             groups.setdefault(tuple(p.arr.shape) + (str(p.arr.dtype),),
                               []).append(p)
+        now = time.monotonic()
         for _, group in groups.items():
             x = np.stack([p.arr for p in group])
             self._count(batches=1, batch_rows=len(group))
+            self._m_batch_size.observe(len(group))
+            for p in group:
+                self._m_queue_wait.observe((now - p.enq_t) * 1000.0)
+            t_inf = time.monotonic()
             try:
                 out = self.model.predict(x)
-                for p, row in zip(group, out):
-                    self._reply(p, {"uuid": p.uuid}, row)
+                infer_ms = (time.monotonic() - t_inf) * 1000.0
+                self._m_infer.observe(infer_ms)
+                # count BEFORE sending: a client that reacts to the
+                # reply must already see consistent counters in stats()
+                # (requests == replies + errors + pending at all times)
                 self._count(replies=len(group))
+                for p, row in zip(group, out):
+                    stages = None
+                    if p.trace is not None:
+                        # per-stage breakdown rides the reply header so
+                        # the client can answer "where did the latency
+                        # go?" without a second round trip
+                        stages = {
+                            "server.queue_wait_ms":
+                                round((now - p.enq_t) * 1000.0, 3),
+                            "server.inference_ms": round(infer_ms, 3),
+                            "server.batch_size": len(group)}
+                        trace_lib.record(p.trace, "server.batch", stages)
+                    self._reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                    "stages": stages}, row)
             except Exception as e:  # noqa: BLE001 — report to the client
                 logger.warning("inference failed: %s", e)
                 self._count(errors=len(group))
                 for p in group:
-                    self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
+                    self._reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                    "error": str(e)}, None)
 
     def _reply(self, p: _Pending, header: Dict[str, Any],
                arr: Optional[np.ndarray]) -> None:

@@ -126,6 +126,26 @@ def _device_info():
     return jax.device_count(), dev.device_kind, peak_flops_per_chip()
 
 
+def _train_registry_detail() -> dict:
+    """Step-loop telemetry snapshot (core/metrics.py) for the bench
+    record: step-time / data-wait p50+p99 and throughput counters, so
+    the BENCH_*.json trajectory carries the same numbers a production
+    scrape would."""
+    from analytics_zoo_tpu.core import metrics as metrics_lib
+    snap = metrics_lib.get_registry().snapshot()
+    out = {}
+    for key in ("train.step_ms", "train.data_wait_ms"):
+        h = snap.get(key)
+        if isinstance(h, dict) and h.get("count"):
+            out[key + ".p50"] = h["p50"]
+            out[key + ".p99"] = h["p99"]
+            out[key + ".count"] = h["count"]
+    for key in ("train.steps", "train.samples"):
+        if key in snap:
+            out[key] = snap[key]
+    return out
+
+
 def _put_chunk(tree, mesh):
     """Place a host [K, B, ...] chunk: batch dim (axis 1) sharded over the
     mesh's data axis, step dim (axis 0) unsharded."""
@@ -582,7 +602,8 @@ def bench_lenet() -> None:
           {"loss_first_epoch": round(hist["loss"][0], 4),
            "loss_last_epoch": round(hist["loss"][-1], 4),
            "learned": learned, "chips": n_chips, "device_kind": kind,
-           "global_batch": batch})
+           "global_batch": batch,
+           "registry": _train_registry_detail()})
 
 
 # -- ncf ----------------------------------------------------------------------
@@ -638,7 +659,8 @@ def bench_ncf() -> None:
           {"rows_after_negative_sampling": len(xy[0]),
            "feature_pipeline_s": round(feat_dt, 2),
            "epoch_loss": round(hist["loss"][-1], 4),
-           "chips": n_chips, "device_kind": kind, "global_batch": batch})
+           "chips": n_chips, "device_kind": kind, "global_batch": batch,
+           "registry": _train_registry_detail()})
 
 
 # -- autots -------------------------------------------------------------------

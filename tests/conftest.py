@@ -34,6 +34,19 @@ def _fresh_context():
 
 
 @pytest.fixture(autouse=True)
+def _telemetry_reset():
+    """Each test reads a zeroed metrics registry and trace ring: the
+    registry is process-global and tests assert absolute counts.
+    ``reset()`` zeroes values in place, so handles cached by long-lived
+    objects (a module-scoped server fixture) stay valid."""
+    from analytics_zoo_tpu.core import metrics, trace
+    metrics.get_registry().reset()
+    metrics.get_registry().enabled = True
+    trace.reset()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _fault_registry_disarmed():
     """Suite hygiene: a test that arms a fault-injection point must disarm
     it (use ``registry.armed(...)`` — it always does).  A leaked armed
