@@ -100,6 +100,21 @@ class ZooConfig:
     default_dtype: str = "float32"
     compute_dtype: str = "bfloat16"      # matmul/conv dtype on the MXU
     remat: bool = False                  # jax.checkpoint the model fn
+    # input-pipeline lookahead (orca/learn/estimator.py fit(prefetch=)):
+    # background-thread double buffering between the feed and the train
+    # step — host batch assembly + device_put of step k+1 overlap the
+    # device compute of step k.  0 = iterate the feed inline (the
+    # pre-pipeline behavior, for bisection).
+    prefetch: int = 2
+
+    # serving hot path (serving/server.py pipeline)
+    # concurrent model-call threads pulling assembled batches; bounded
+    # by InferenceModel.concurrent_num.  1 = strictly ordered inference
+    # (the pre-pipeline behavior, for bisection).
+    inference_workers: int = 2
+    # per-shape-bucket staging buffers kept for reuse by batch assembly
+    # (None = inference_workers + 2)
+    staging_pool: Optional[int] = None
 
     # logging / summaries (reference: set_tensorboard, TrainSummary)
     log_dir: str = "/tmp/analytics_zoo_tpu"

@@ -129,6 +129,17 @@ class OrcaContext(metaclass=_ZooContextMeta):
     """Process-global context singleton (reference: pyzoo/zoo/orca/common.py)."""
 
 
+def config_default(field: str, fallback: Any) -> Any:
+    """``ZooConfig.<field>`` when a context is initialized, else
+    ``fallback`` — the one lookup every knob with a config-file default
+    (serving ``inference_workers``/``staging_pool``, estimator
+    ``prefetch``) shares, so a future ZooConfig default change cannot
+    silently diverge from a hardcoded copy."""
+    if OrcaContext.initialized:
+        return getattr(OrcaContext.config, field, fallback)
+    return fallback
+
+
 def make_mesh(mesh_shape: Optional[Dict[str, int] | MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None,
               ) -> jax.sharding.Mesh:

@@ -175,6 +175,12 @@ class Histogram:
             self.sum += value
             self.count += 1
 
+    def time(self) -> "_HistogramTimer":
+        """Context manager observing the block's wall time in ms:
+        ``with hist.time(): ...`` — the idiom the pipelined serving
+        stages use for their per-stage latency series."""
+        return _HistogramTimer(self)
+
     def percentile(self, q: float) -> float:
         """Approximate q-quantile (q in [0, 1]) from bucket counts."""
         with self._lock:
@@ -206,6 +212,24 @@ class Histogram:
                 "mean": round(total / count, 6) if count else 0.0,
                 "p50": round(self.percentile(0.50), 6),
                 "p99": round(self.percentile(0.99), 6)}
+
+
+class _HistogramTimer:
+    """``with hist.time():`` — observe elapsed milliseconds on exit
+    (monotonic clock; observes even when the block raises, so error
+    paths stay visible in the latency distribution)."""
+
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self) -> "_HistogramTimer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._hist.observe((time.monotonic() - self._t0) * 1000.0)
 
 
 class MetricsRegistry:

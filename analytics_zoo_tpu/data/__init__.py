@@ -1,6 +1,7 @@
 """Data layer: XShards, file readers, device feed (reference L4, SURVEY.md §2.2)."""
 
-from .feed import DataFeed, as_feed, batch_sharding, shard_batch
+from .feed import (DataFeed, PrefetchIterator, as_feed, batch_sharding,
+                   shard_batch)
 from .readers import read_csv, read_json, read_npz, read_parquet
 from .shards import XShards
 from .stream import StreamingDataFeed
@@ -15,7 +16,8 @@ from .interop import (IterableDataFeed, from_iterator, from_tf_dataset,
 from . import readers as pandas  # noqa: F401
 
 __all__ = [
-    "XShards", "DataFeed", "as_feed", "batch_sharding", "shard_batch",
+    "XShards", "DataFeed", "PrefetchIterator", "as_feed", "batch_sharding",
+    "shard_batch",
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
     "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
     "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "ImageBrightness",

@@ -16,6 +16,8 @@ Static shapes: batches are fixed-size (remainder dropped or padded) so the
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
@@ -257,6 +259,107 @@ class DataFeed(FeedBase):
                    if step + 1 < steps else None)
             yield pending
             pending = nxt
+
+
+class PrefetchIterator:
+    """Depth-bounded background prefetch over a batch iterator.
+
+    A producer thread drives the wrapped iterator — for DataFeed /
+    StreamingDataFeed epochs that means the host-side batch indexing,
+    ``shard_batch`` and the ``device_put`` dispatch all happen OFF the
+    training thread — and parks up to ``depth`` ready batches in a
+    bounded queue (``depth=2`` is classic double buffering: batch k+1
+    stages while the device computes batch k, and one more is in
+    flight).  The consumer's ``next()`` then only blocks when the feed
+    is genuinely slower than the step, which is exactly what the
+    ``train.data_wait_ms`` histogram should measure.
+
+    Exceptions from the producer (loader failures, injected
+    ``feed.stall``-adjacent faults) re-raise in the consumer at the
+    position they occurred.  ``close()`` is safe mid-epoch (rollback,
+    preemption, crash injection): it unblocks and joins the producer
+    without draining the rest of the epoch.
+    """
+
+    _END = object()
+
+    def __init__(self, it: Iterator, depth: int = 2,
+                 gauge: Optional[Any] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = iter(it)
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+        self._gauge = gauge  # e.g. the train.prefetch_depth gauge
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="zoo-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                if self._gauge is not None:
+                    self._gauge.set(self._q.qsize())
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for batch in self._it:
+                if not self._put(("item", batch)):
+                    return  # closed mid-epoch
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._put(("error", e))
+            return
+        self._put((self._END, None))
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        kind, payload = self._q.get()
+        if self._gauge is not None:
+            self._gauge.set(self._q.qsize())
+        if kind == "item":
+            return payload
+        self._stop.set()
+        if kind == "error":
+            raise payload
+        raise StopIteration
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer and reclaim its thread (idempotent).  The
+        wait is BOUNDED: a producer wedged inside the wrapped iterator
+        itself (a hung loader) cannot be interrupted from here — after
+        ``timeout`` the daemon thread is abandoned (it exits at its next
+        queue handoff) rather than turning the caller's own exit (e.g. a
+        clean preemption) into a hang."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive():
+            try:  # unblock a producer stuck on a full queue
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if time.monotonic() > deadline:
+                break
+        if not self._thread.is_alive():
+            close_it = getattr(self._it, "close", None)
+            if close_it is not None:
+                try:  # prompt generator cleanup (stream feeds join
+                    close_it()  # their decode workers)
+                except (RuntimeError, ValueError):
+                    pass
+        if self._gauge is not None:
+            self._gauge.set(0.0)
 
 
 def as_feed(data: Any, batch_size: int, **kw: Any) -> DataFeed:

@@ -92,6 +92,11 @@ class StreamingDataFeed(FeedBase):
         self._m_failures = reg.counter("feed.load_failures")
         self._m_retries = reg.counter("feed.retries")
         self._m_skipped = reg.counter("feed.skipped_rows")
+        # decoded-batch lookahead occupancy (high-water mark = realized
+        # prefetch depth): a gauge pinned at 0 means the consumer eats
+        # batches as fast as the workers decode them — the feed, not the
+        # device, is the bottleneck
+        self._m_ready = reg.gauge("feed.ready_depth")
 
     # -- resilient sample loading --------------------------------------------
 
@@ -219,6 +224,7 @@ class StreamingDataFeed(FeedBase):
                     return
                 with ready_cond:
                     ready[step] = batch
+                    self._m_ready.set(len(ready))
                     ready_cond.notify_all()
                 try:
                     queue.push(step.to_bytes(8, "big"))  # blocks when full
@@ -246,7 +252,9 @@ class StreamingDataFeed(FeedBase):
             while True:
                 with ready_cond:
                     if expected_step in ready:
-                        return ready.pop(expected_step)
+                        batch = ready.pop(expected_step)
+                        self._m_ready.set(len(ready))
+                        return batch
                     if errors:
                         raise errors[0]
                     if len(ready) >= bound:

@@ -36,11 +36,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from analytics_zoo_tpu.core import checkpoint as ckpt_io
 from analytics_zoo_tpu.core import get_mesh
+from analytics_zoo_tpu.core.config import ZooConfig
 from analytics_zoo_tpu.core import faults as faults_lib
 from analytics_zoo_tpu.core import metrics as telemetry
 from analytics_zoo_tpu.core.context import heartbeat
 from analytics_zoo_tpu.core.summary import SummaryWriter
-from analytics_zoo_tpu.data import as_feed, batch_sharding, shard_batch
+from analytics_zoo_tpu.data import (PrefetchIterator, as_feed,
+                                    batch_sharding, shard_batch)
 from analytics_zoo_tpu.nn import losses as losses_lib
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn.module import Module
@@ -461,6 +463,7 @@ class ZooEstimator:
             feature_cols: Optional[Sequence[str]] = None,
             label_cols: Optional[Sequence[str]] = None,
             auto_resume: bool = False,
+            prefetch: Optional[int] = None,
             verbose: bool = True) -> Dict[str, List[float]]:
         """Train; returns history {"loss": [...], "val_<metric>": [...]}.
 
@@ -468,8 +471,19 @@ class ZooEstimator:
         ``batch_size`` is global (split across the mesh's batch axes).
         ``auto_resume``: restore from ``model_dir`` if a checkpoint exists
         (the restart half of preemption-safe training).
+        ``prefetch``: feed-lookahead depth (default
+        ``ZooConfig.prefetch``, 2) — a background thread runs the feed's
+        host batch indexing, ``shard_batch`` and the ``device_put``
+        dispatch of step k+1 while the device computes step k, so
+        ``train.data_wait_ms`` measures only genuinely feed-bound time.
+        ``prefetch=0`` iterates the feed inline on the training thread
+        (the pre-pipeline behavior, for bisection).
         """
         mesh = get_mesh()
+        if prefetch is None:
+            from analytics_zoo_tpu.core.context import config_default
+            prefetch = config_default("prefetch",
+                                      ZooConfig.prefetch)
         if (auto_resume and self._ts is None and self.model_dir
                 and ckpt_io.exists(self.model_dir)):
             self.load(self.model_dir)
@@ -502,6 +516,7 @@ class ZooEstimator:
         m_steps = reg.counter("train.steps")
         m_samples = reg.counter("train.samples")
         m_bad = reg.counter("train.bad_steps")
+        m_prefetch = reg.gauge("train.prefetch_depth")
 
         if self._preempt is not None:
             self._preempt.active = True
@@ -519,75 +534,97 @@ class ZooEstimator:
                 bad_before = self.bad_steps
                 rolled_back = False
                 batch_iter = iter(feed.epoch(mesh, self._epoch))
-                while True:
-                    t_fetch = time.monotonic()
-                    batch = next(batch_iter, None)
-                    if batch is None:
-                        break
-                    wait = time.monotonic() - t_fetch
-                    epoch_wait += wait
-                    m_wait.observe(wait * 1000.0)
-                    if "mask" in batch:
-                        # a padded final batch from a stream feed: training
-                        # on it would weight the duplicated pad rows fully
-                        # (and retrace train_step on the extra key) — skip
-                        # it, the drop_remainder semantics every training
-                        # feed defaults to.  evaluate() still consumes
-                        # these batches exactly.
-                        continue
-                    if first:
-                        self._ensure_initialized(batch["x"])
-                        first = False
-                    # liveness beat for the zoo-launch gang supervisor
-                    # (no-op unless a heartbeat file is configured); the
-                    # payload makes the heartbeat file a tiny status
-                    # report the supervisor can aggregate
-                    heartbeat(step=self._py_step)
-                    # worker fault seams (core/faults.py): a hard worker
-                    # death and a wedged step, both disarmed no-ops in
-                    # production and armed by gang-supervision tests
-                    if faults.fire("worker.crash"):
-                        logger.error("injected worker.crash at step %d",
-                                     self._py_step)
-                        os._exit(1)
-                    faults.fire("worker.hang")  # armed delay = hung step
-                    if faults.fire("step.nan"):
-                        batch = _poison_batch(batch)
-                    self._maybe_profile()
-                    self._ts, loss_val = self._train_step(self._ts, batch)
-                    losses.append(loss_val)
-                    # track the step in Python: reading self._ts["step"]
-                    # would force a device sync on every iteration
-                    self._py_step += 1
-                    m_step.observe((time.monotonic() - t_fetch) * 1000.0)
-                    m_steps.inc()
-                    m_samples.inc(feed.global_batch)
-                    if host_nan_check and not math.isfinite(
-                            float(loss_val)):
-                        self.bad_steps += 1
-                        m_bad.inc()
-                        if self.nan_policy == "raise":
-                            self._stop_profile()
-                            raise NonFiniteLossError(self._py_step)
-                        if self.nan_policy == "warn":
-                            logger.warning(
-                                "non-finite loss at step %d (nan_policy="
-                                "'warn'): training continues on possibly "
-                                "poisoned parameters", self._py_step)
-                        else:
-                            self._rollback_to_checkpoint()
-                            rolled_back = True
+                if prefetch and prefetch > 0:
+                    # depth-2 double buffering by default: the feed's
+                    # host work for step k+1 (slice/stack, shard_batch,
+                    # device_put dispatch) overlaps the device compute
+                    # of step k on a background thread
+                    batch_iter = PrefetchIterator(batch_iter,
+                                                  depth=prefetch,
+                                                  gauge=m_prefetch)
+                try:
+                    while True:
+                        t_fetch = time.monotonic()
+                        batch = next(batch_iter, None)
+                        if batch is None:
                             break
-                    if (self._preempt is not None
-                            and self._preempt.should_checkpoint(
-                                self._py_step)):
-                        self._stop_profile()
-                        path = self.save(self.model_dir)
-                        from analytics_zoo_tpu.core.failover import Preempted
-                        raise Preempted(self._py_step, path)
-                    if trigger and self.model_dir and trigger.fires(
-                            step=self._py_step, epoch_end=False):
-                        self.save(self.model_dir)
+                        wait = time.monotonic() - t_fetch
+                        epoch_wait += wait
+                        m_wait.observe(wait * 1000.0)
+                        if "mask" in batch:
+                            # a padded final batch from a stream feed:
+                            # training on it would weight the duplicated
+                            # pad rows fully (and retrace train_step on
+                            # the extra key) — skip it, the
+                            # drop_remainder semantics every training
+                            # feed defaults to.  evaluate() still
+                            # consumes these batches exactly.
+                            continue
+                        if first:
+                            self._ensure_initialized(batch["x"])
+                            first = False
+                        # liveness beat for the zoo-launch gang
+                        # supervisor (no-op unless a heartbeat file is
+                        # configured); the payload makes the heartbeat
+                        # file a tiny status report the supervisor can
+                        # aggregate
+                        heartbeat(step=self._py_step)
+                        # worker fault seams (core/faults.py): a hard
+                        # worker death and a wedged step, both disarmed
+                        # no-ops in production and armed by
+                        # gang-supervision tests
+                        if faults.fire("worker.crash"):
+                            logger.error("injected worker.crash at step "
+                                         "%d", self._py_step)
+                            os._exit(1)
+                        faults.fire("worker.hang")  # armed delay = hang
+                        if faults.fire("step.nan"):
+                            batch = _poison_batch(batch)
+                        self._maybe_profile()
+                        self._ts, loss_val = self._train_step(self._ts,
+                                                              batch)
+                        losses.append(loss_val)
+                        # track the step in Python: reading
+                        # self._ts["step"] would force a device sync on
+                        # every iteration
+                        self._py_step += 1
+                        m_step.observe(
+                            (time.monotonic() - t_fetch) * 1000.0)
+                        m_steps.inc()
+                        m_samples.inc(feed.global_batch)
+                        if host_nan_check and not math.isfinite(
+                                float(loss_val)):
+                            self.bad_steps += 1
+                            m_bad.inc()
+                            if self.nan_policy == "raise":
+                                self._stop_profile()
+                                raise NonFiniteLossError(self._py_step)
+                            if self.nan_policy == "warn":
+                                logger.warning(
+                                    "non-finite loss at step %d "
+                                    "(nan_policy='warn'): training "
+                                    "continues on possibly poisoned "
+                                    "parameters", self._py_step)
+                            else:
+                                self._rollback_to_checkpoint()
+                                rolled_back = True
+                                break
+                        if (self._preempt is not None
+                                and self._preempt.should_checkpoint(
+                                    self._py_step)):
+                            self._stop_profile()
+                            path = self.save(self.model_dir)
+                            from analytics_zoo_tpu.core.failover import \
+                                Preempted
+                            raise Preempted(self._py_step, path)
+                        if trigger and self.model_dir and trigger.fires(
+                                step=self._py_step, epoch_end=False):
+                            self.save(self.model_dir)
+                finally:
+                    # mid-epoch exits (rollback, preemption, raise) must
+                    # not leak the prefetch producer thread
+                    if isinstance(batch_iter, PrefetchIterator):
+                        batch_iter.close()
                 if rolled_back:
                     # epoch/step rewound to the restored ckpt; drop history
                     # entries for epochs about to be re-run (a mid-epoch

@@ -72,7 +72,15 @@ class RetryPolicy:
 
 class _Conn:
     """Shared connection + background reader demuxing replies by uuid,
-    with reconnect + idempotent resend of in-flight frames."""
+    with reconnect + idempotent resend of in-flight frames.
+
+    Request frames are kept as one contiguous ``bytes`` (the resend
+    record needs the full frame anyway); replies arrive through the
+    zero-copy receive path (``protocol.recv_frame``'s single
+    preallocated buffer), so the decoded ndarray aliases the receive
+    buffer instead of copying.  A reply whose length prefix exceeds
+    ``protocol.MAX_FRAME_BYTES`` kills the reader (ValueError) exactly
+    like a dead socket — the reconnect path takes over."""
 
     #: replies for abandoned uuids (query timed out before the server
     #: answered) are evicted oldest-first beyond this bound
@@ -419,7 +427,10 @@ class OutputQueue:
                 if info is not None:
                     # close out the end-to-end trace: client-observed
                     # total + the server's per-stage breakdown from the
-                    # reply header, one record, one correlatable id
+                    # reply header (stamped by the inference worker that
+                    # ran the batch: queue wait, batch assembly,
+                    # inference, realized batch size), one record, one
+                    # correlatable id
                     tid, t0 = info
                     total = (time.monotonic() - t0) * 1000.0
                     all_stages = {"client.total_ms": round(total, 3)}

@@ -17,7 +17,9 @@ Endpoints (TF-Serving-flavored JSON):
                   → {"predictions": <nested list>}
   GET  /health    → {"status": "ok"}
   GET  /stats     → namespaced counters: ``frontend.*`` (this gateway),
-                    ``client.*`` (the resilient backend connection) and
+                    ``client.*`` (the resilient backend connection),
+                    ``server.*`` (the serving pipeline's counters, when
+                    the backend is co-located in this process) and
                     ``frontend.request_ms.*`` route-latency summaries,
                     PLUS a flat back-compat view (the pre-registry key
                     names: ``requests``, ``timeouts``, ``reconnects``,
@@ -227,6 +229,14 @@ class HTTPFrontend:
         # no conn.stats mirror) complete the namespaced view
         for key, v in self._metrics.flat(prefix="client.").items():
             out.setdefault(f"client.{key}", v)
+        # co-located serving pipeline counters (requests / replies /
+        # rejected / shed / drained + the queue-depth gauge): when the
+        # backend shares this process registry, one /stats poll answers
+        # "is the pipeline shedding or backpressuring?" without a
+        # second endpoint; remote backends simply contribute no
+        # server.* series here
+        for key, v in self._metrics.flat(prefix="server.").items():
+            out.setdefault(f"server.{key}", v)
         snap = self._metrics.snapshot()
         for series, val in snap.items():
             if series.startswith("frontend.request_ms"):

@@ -2,8 +2,26 @@
 
 Frame = 4-byte big-endian length + payload.  Payload = header json (utf-8)
 + b"\\0" + raw ndarray bytes.  Replaces the reference's
-ndarray→Arrow→base64→Redis encoding (pyzoo/zoo/serving/client.py) with a
-single-copy binary framing.
+ndarray→Arrow→base64→Redis encoding (pyzoo/zoo/serving/client.py) with
+zero-copy binary framing:
+
+- **send**: ``encode_parts`` + ``send_frame_parts`` scatter-gather the
+  frame as ``[len+header, memoryview(tensor)]`` through ``sendmsg`` — the
+  tensor payload is never copied into a joined bytes object (the old
+  ``ascontiguousarray(arr).tobytes()`` + two concatenations cost three
+  copies per reply).  ``encode`` still returns one ``bytes`` for callers
+  that must hold the full frame (the resilient client records it for
+  idempotent resend).
+- **recv**: ``recv_frame`` reads into a single preallocated buffer via
+  ``recv_into`` (the old chunk list + ``b"".join`` copied every payload
+  once more), and ``decode`` wraps the tensor bytes in a ``memoryview``
+  so ``np.frombuffer`` aliases the receive buffer instead of copying.
+
+``MAX_FRAME_BYTES`` guards the 4-byte length against corrupt or
+malicious values: without it a bad length triggers an up-to-4 GiB
+allocation attempt before any validation.  Oversized frames raise
+``ValueError`` — both the server's connection loop and the client's
+reader treat that as a dead connection.
 """
 
 from __future__ import annotations
@@ -11,52 +29,106 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+#: Upper bound on a single frame's payload (default 256 MiB).  A length
+#: prefix above this is treated as protocol corruption, not a request.
+#: Module-level so deployments (and tests) can raise/lower it.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
 
-def encode(header: Dict[str, Any], arr: Optional[np.ndarray] = None) -> bytes:
+Frame = Union[bytes, bytearray]
+
+
+def encode(header: Dict[str, Any], arr: Optional[np.ndarray] = None
+           ) -> bytes:
+    """One contiguous frame (length prefix included).  Costs one copy of
+    the tensor payload — use ``encode_parts`` on hot reply paths where
+    the frame does not need to outlive the send."""
+    return b"".join(encode_parts(header, arr))
+
+
+def encode_parts(header: Dict[str, Any],
+                 arr: Optional[np.ndarray] = None) -> List[memoryview]:
+    """The frame as scatter-gather buffers ``[len+header+\\0, tensor]``
+    with NO copy of the tensor payload (a ``memoryview`` over the
+    array's buffer; ``ascontiguousarray`` is a no-op for the contiguous
+    arrays the serving path produces).  Pass to ``send_frame_parts``."""
     if arr is not None:
-        header = dict(header, dtype=str(arr.dtype), shape=list(arr.shape))
-        body = np.ascontiguousarray(arr).tobytes()
+        a = np.ascontiguousarray(arr)
+        header = dict(header, dtype=str(a.dtype), shape=list(a.shape))
+        body = memoryview(a).cast("B")
     else:
-        body = b""
-    head = json.dumps(header).encode()
-    payload = head + b"\0" + body
-    return struct.pack(">I", len(payload)) + payload
+        body = memoryview(b"")
+    head = json.dumps(header).encode() + b"\0"
+    parts = [memoryview(struct.pack(">I", len(head) + len(body)) + head)]
+    if len(body):
+        parts.append(body)
+    return parts
 
 
-def decode(payload: bytes) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
+def send_frame(sock: socket.socket, data: Frame) -> None:
+    sock.sendall(data)
+
+
+def send_frame_parts(sock: socket.socket, parts: List[memoryview]) -> None:
+    """Scatter-gather send via ``sendmsg`` (one syscall, no join copy),
+    handling partial sends; falls back to ``sendall`` of the joined
+    frame where ``sendmsg`` is unavailable."""
+    if not hasattr(sock, "sendmsg"):  # pragma: no cover - exotic platform
+        sock.sendall(b"".join(parts))
+        return
+    bufs = [p if isinstance(p, memoryview) else memoryview(p)
+            for p in parts]
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        # a partial scatter-gather send is legal: drop fully-sent
+        # buffers, slice the straddled one, and go again
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if bufs and sent:
+            bufs[0] = bufs[0][sent:]
+
+
+def decode(payload: Frame) -> Tuple[Dict[str, Any], Optional[np.ndarray]]:
     sep = payload.index(b"\0")
-    header = json.loads(payload[:sep].decode())
-    body = payload[sep + 1:]
+    mv = memoryview(payload)
+    header = json.loads(bytes(mv[:sep]).decode())
     arr = None
     if "dtype" in header:
-        arr = np.frombuffer(body, dtype=header["dtype"]).reshape(
+        # zero-copy: the array aliases the receive buffer (recv_frame
+        # allocates one buffer per frame, so aliasing is safe)
+        arr = np.frombuffer(mv[sep + 1:], dtype=header["dtype"]).reshape(
             header["shape"])
     return header, arr
 
 
-def send_frame(sock: socket.socket, data: bytes) -> None:
-    sock.sendall(data)
-
-
-def recv_frame(sock: socket.socket) -> Optional[bytes]:
-    raw_len = _recv_exact(sock, 4)
-    if raw_len is None:
+def recv_frame(sock: socket.socket) -> Optional[bytearray]:
+    """One frame's payload into a single preallocated buffer (None on
+    clean EOF).  Raises ValueError when the length prefix exceeds
+    ``MAX_FRAME_BYTES`` — validate before allocating, so a corrupt or
+    malicious 4-byte length cannot demand gigabytes."""
+    hdr = bytearray(4)
+    if not _recv_into_exact(sock, memoryview(hdr)):
         return None
-    (length,) = struct.unpack(">I", raw_len)
-    return _recv_exact(sock, length)
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"frame length {length} exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}): corrupt or malicious peer")
+    buf = bytearray(length)
+    if not _recv_into_exact(sock, memoryview(buf)):
+        return None
+    return buf
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    got = 0
+def _recv_into_exact(sock: socket.socket, mv: memoryview) -> bool:
+    got, n = 0, len(mv)
     while got < n:
-        chunk = sock.recv(n - got)
-        if not chunk:
-            return None
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+        k = sock.recv_into(mv[got:])
+        if not k:
+            return False
+        got += k
+    return True

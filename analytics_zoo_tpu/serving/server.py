@@ -5,18 +5,36 @@ Reference (SURVEY.md §2.8/§3.5): a Flink streaming job polled Redis
 (OpenVINO/TF/BigDL), and wrote results back to per-key Redis entries; an
 akka-HTTP frontend fed the same queue.
 
-TPU-native redesign: one process, three stages —
-  1. a TCP acceptor thread per connection parses frames and pushes requests
-     onto a NATIVE C++ bounded queue (the Redis-list equivalent);
-  2. a batcher thread pops up to ``batch_size`` requests (or ``timeout_ms``),
-     stacks them, and runs the AOT-compiled InferenceModel once;
-  3. results are delivered back over the same connection, keyed by the
-     client-supplied uuid (OutputQueue.query matches on it).
+TPU-native redesign: one process, a PIPELINE of stages so host work
+overlaps device work end to end (the monolithic batcher serialized
+assembly → inference → reply on one thread, so a slow client socket
+stalled all inference):
+
+  1. a TCP acceptor thread per connection parses frames and pushes
+     requests onto a NATIVE C++ bounded queue (the Redis-list
+     equivalent);
+  2. an ASSEMBLY thread pops up to ``batch_size`` requests (or
+     ``batch_timeout_ms``), sheds expired deadlines, groups by input
+     shape, and writes each group's rows into a REUSED per-shape
+     staging buffer (no fresh ``np.stack`` allocation per batch),
+     pushing assembled batches onto a small internal queue;
+  3. ``inference_workers`` threads (default 2, bounded by
+     ``InferenceModel.concurrent_num``) pull assembled batches and run
+     the AOT-compiled model — batch k+1 assembles while batch k
+     computes, and with 2 workers two shape groups infer concurrently;
+  4. a per-connection REPLY WRITER thread encodes (zero-copy
+     scatter-gather, see protocol.py) and sends each reply, so frame
+     encoding and ``sendall`` never block the next ``model.predict``
+     and one slow-reading client backpressures only its own connection.
+
+``inference_workers=1`` restores the strictly serialized inference
+order of the pre-pipeline server (bisection baseline).
 """
 
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
 import socket
 import threading
 import time
@@ -35,24 +53,135 @@ from . import protocol
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
+def _config_default(field: str, fallback: Any) -> Any:
+    """ZooConfig value for ``field`` when a context is initialized,
+    else ``fallback`` (serving knobs ride the same config file as the
+    rest of the framework).  Lazy import: serving must stay importable
+    without bootstrapping a device context."""
+    from analytics_zoo_tpu.core.context import config_default
+    return config_default(field, fallback)
+
+
 class _Pending:
-    __slots__ = ("uuid", "arr", "conn", "lock", "expires", "trace",
-                 "enq_t")
+    __slots__ = ("uuid", "arr", "conn", "lock", "writer", "expires",
+                 "trace", "enq_t", "wait_ms")
 
     def __init__(self, uid: str, arr: np.ndarray, conn: socket.socket,
-                 lock: threading.Lock, expires: Optional[float] = None,
+                 lock: threading.Lock, writer: "Optional[_ConnWriter]",
+                 expires: Optional[float] = None,
                  trace: Optional[str] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
         self.lock = lock
+        self.writer = writer  # per-connection outbound stage
         # absolute time.monotonic() deadline (from the client's
         # ``deadline_ms`` budget, re-anchored at arrival); None = no limit
         self.expires = expires
         # trace id from the frame header (core/trace.py): rides every
         # reply so the client can correlate its per-stage breakdown
         self.trace = trace
-        self.enq_t = time.monotonic()  # arrival → batcher = queue wait
+        self.enq_t = time.monotonic()  # arrival → assembly = queue wait
+        self.wait_ms = 0.0             # filled at assembly pickup
+
+
+class _AssembledBatch:
+    """One shape-grouped batch staged for inference: the pending
+    requests, the staged input (a view into a pooled buffer), and the
+    pool key/buffer to release once inference materialized its output."""
+
+    __slots__ = ("group", "x", "buf_key", "buf", "assembly_ms")
+
+    def __init__(self, group: List[_Pending], x: np.ndarray,
+                 buf_key: Tuple, buf: np.ndarray, assembly_ms: float):
+        self.group = group
+        self.x = x
+        self.buf_key = buf_key
+        self.buf = buf
+        self.assembly_ms = assembly_ms
+
+
+class _ConnWriter:
+    """Per-connection reply stage: a bounded outbound queue + one writer
+    thread doing encode + scatter-gather send.  Inference workers hand
+    replies over and move straight to the next batch; a client that
+    stops reading blocks only its own writer (its queue then
+    backpressures only requests from that connection)."""
+
+    def __init__(self, conn: socket.socket, send_lock: threading.Lock,
+                 reply_hist: metrics_lib.Histogram,
+                 max_items: Optional[int] = None):
+        self._conn = conn
+        self._lock = send_lock
+        self._m_reply = reply_hist
+        self._q: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max_items or self.MAX_ITEMS)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="zoo-serving-reply")
+        self._thread.start()
+
+    #: outbound queue bound: a conforming client keeps far fewer replies
+    #: outstanding (the resilient client caps in-flight at 1024)
+    MAX_ITEMS = 4096
+    #: how long push() tolerates a FULL writer queue before declaring
+    #: the client dead.  A full queue means MAX_ITEMS replies sit unread
+    #: — waiting longer would stall the SHARED inference workers (and
+    #: stop()'s drain) on one broken client.
+    PUSH_GRACE_S = 1.0
+
+    def push(self, header: Dict[str, Any],
+             arr: Optional[np.ndarray]) -> bool:
+        """Enqueue one reply; False once the writer is closed (the
+        caller falls back to a best-effort direct send).  A queue that
+        stays full past ``PUSH_GRACE_S`` kills the connection: the
+        client is not reading and the workers must not block on it."""
+        deadline = time.monotonic() + self.PUSH_GRACE_S
+        while not self._closed.is_set():
+            try:
+                self._q.put((header, arr), timeout=0.1)
+                return True
+            except queue_mod.Full:
+                if time.monotonic() > deadline:
+                    logger.warning(
+                        "reply writer queue full for %.1fs: client is "
+                        "not reading; dropping the connection",
+                        self.PUSH_GRACE_S)
+                    self._closed.set()
+                    try:  # unblock the writer's in-flight sendall too
+                        self._conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    return False
+        return False
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue_mod.Empty:
+                if self._closed.is_set():
+                    return  # closed AND flushed
+                continue
+            header, arr = item
+            with self._m_reply.time():
+                try:
+                    with self._lock:
+                        protocol.send_frame_parts(
+                            self._conn, protocol.encode_parts(header, arr))
+                except (OSError, ValueError):
+                    pass  # client gone; counters were final pre-send
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop after flushing queued replies (sends to a dead socket
+        fail fast, so a closed connection drains immediately)."""
+        self._closed.set()
+        if timeout is not None:
+            self._thread.join(timeout=timeout)
 
 
 class ClusterServing:
@@ -63,17 +192,47 @@ class ClusterServing:
                  port: int = 0, batch_size: int = 16,
                  batch_timeout_ms: int = 5, queue_items: int = 4096,
                  push_timeout: float = 5.0,
+                 inference_workers: Optional[int] = None,
+                 staging_pool: Optional[int] = None,
                  faults: Optional[FaultRegistry] = None,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None):
+        """``inference_workers``: concurrent model-call threads pulling
+        assembled batches (default from ``ZooConfig.inference_workers``,
+        2; bounded by the model's ``concurrent_num``).  1 restores the
+        pre-pipeline strictly-ordered inference for bisection.
+
+        ``staging_pool``: per-shape-bucket staging buffers kept for
+        reuse (default ``inference_workers + 2``); beyond the pool,
+        assembly allocates fresh buffers rather than blocking."""
         self.model = model
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
         self.push_timeout = push_timeout  # how long accept blocks when full
+        if inference_workers is None:
+            inference_workers = _config_default("inference_workers", 2)
+        bound = getattr(model, "concurrent_num", None)
+        self.inference_workers = max(1, min(
+            int(inference_workers),
+            int(bound) if bound else int(inference_workers)))
+        if staging_pool is None:
+            staging_pool = _config_default("staging_pool", None)
+        self.staging_pool = (int(staging_pool) if staging_pool
+                             else self.inference_workers + 2)
         self._faults = faults or get_registry()
         self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
+        # assembled-batch queue: SMALL on purpose — backpressure must
+        # reach the native queue (and from there the "queue full"
+        # rejection path) instead of hiding in an elastic buffer
+        self._batch_q: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max(1, self.inference_workers))
+        self._workers_done = threading.Event()  # drain: exit when empty
         self._pending: Dict[int, _Pending] = {}
         self._pending_lock = threading.Lock()
         self._next_id = 0
+        # staging-buffer pool: (shape, dtype) -> free buffers; rows are
+        # written in place instead of np.stack's fresh allocation
+        self._staging: Dict[Tuple, List[np.ndarray]] = {}
+        self._staging_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -83,6 +242,7 @@ class ClusterServing:
         self._threads: List[threading.Thread] = []
         self._threads_lock = threading.Lock()
         self._conns: set = set()  # open client sockets, for drain/close
+        self._writers: Dict[socket.socket, _ConnWriter] = {}
         # observability (reference: the Flink job's metrics): monotonically
         # increasing counters, read via stats() and mirrored into the
         # process telemetry registry under ``server.*`` (core/metrics.py).
@@ -108,6 +268,8 @@ class ClusterServing:
             "server.batch_size", buckets=metrics_lib.SIZE_BUCKETS)
         self._m_queue_wait = self._metrics.histogram("server.queue_wait_ms")
         self._m_infer = self._metrics.histogram("server.inference_ms")
+        self._m_assembly = self._metrics.histogram("server.assembly_ms")
+        self._m_reply = self._metrics.histogram("server.reply_ms")
         self._m_shed_per_batch = self._metrics.histogram(
             "server.shed_per_batch", buckets=metrics_lib.SIZE_BUCKETS)
 
@@ -135,8 +297,8 @@ class ClusterServing:
         is either answered (reply or error) or still in flight; nothing
         is silently dropped.  Counters are bumped BEFORE the reply frame
         is sent, so the invariant holds from any client's point of view
-        (a stats() poll racing a mid-batch request may transiently see
-        requests exceed the right-hand side while the batch runs)."""
+        (a stats() poll racing in-flight pipeline stages may transiently
+        see requests exceed the right-hand side while a batch runs)."""
         with self._stats_lock:
             c = dict(self._counters)
         c["mean_batch_size"] = (c.pop("batch_rows") / c["batches"]
@@ -145,6 +307,7 @@ class ClusterServing:
             c["pending"] = len(self._pending)
         c["queue_depth"] = self._m_depth.value
         c["queue_depth_max"] = self._m_depth.max
+        c["inference_workers"] = self.inference_workers
         return c
 
     def _count(self, **deltas: int) -> None:
@@ -159,21 +322,30 @@ class ClusterServing:
     def start(self) -> "ClusterServing":
         t_accept = threading.Thread(target=self._accept_loop, daemon=True,
                                     name="zoo-serving-accept")
-        t_batch = threading.Thread(target=self._batch_loop, daemon=True,
-                                   name="zoo-serving-batch")
+        t_assembly = threading.Thread(target=self._assembly_loop,
+                                      daemon=True,
+                                      name="zoo-serving-assembly")
+        workers = [threading.Thread(target=self._worker_loop, args=(i,),
+                                    daemon=True,
+                                    name=f"zoo-serving-infer-{i}")
+                   for i in range(self.inference_workers)]
         with self._threads_lock:
-            self._threads = [t_accept, t_batch]
-        t_accept.start()
-        t_batch.start()
-        logger.info("ClusterServing listening on %s:%d (batch=%d, native "
-                    "queue=%s)", self.host, self.port, self.batch_size,
+            self._threads = [t_accept, t_assembly] + workers
+        for t in self._threads:
+            t.start()
+        logger.info("ClusterServing listening on %s:%d (batch=%d, "
+                    "inference_workers=%d, native queue=%s)", self.host,
+                    self.port, self.batch_size, self.inference_workers,
                     self._queue.is_native)
         return self
 
     def stop(self, drain_timeout: float = 5.0) -> None:
-        """Graceful drain: stop intake, join worker threads, reply
-        ``server shutting down`` to every request still pending (so no
-        client hangs until its own timeout), then close client sockets.
+        """Graceful drain: stop intake, let in-flight pipeline stages
+        finish (assembly → workers → reply writers, in dependency
+        order), reply ``server shutting down`` to every request still
+        pending — whether it was waiting in the native queue or already
+        assembled in the internal batch queue — then close client
+        sockets.
 
         Idempotent — the second and later calls are no-ops."""
         if self._stop.is_set():
@@ -191,11 +363,22 @@ class ClusterServing:
             self._sock.close()
         except OSError:
             pass
-        # join the acceptor + batcher first: the batcher finishes (and
-        # replies to) its in-flight batch, so the drain below only sees
-        # requests that never reached the model
+        # join in pipeline order: acceptor + assembly first (no new
+        # batches), then workers (each finishes — and replies to — the
+        # batch it is currently running; batches still queued stay put
+        # for the drain below), then the reply writers flush.
         with self._threads_lock:
-            workers = list(self._threads)
+            stages = list(self._threads)
+        workers = [t for t in stages if t.name.startswith(
+            "zoo-serving-infer")]
+        for t in stages:
+            if t in workers:
+                continue
+            t.join(timeout=drain_timeout)
+            if t.is_alive():
+                logger.warning("ClusterServing.stop: thread %s did not "
+                               "exit within %.1fs", t.name, drain_timeout)
+        self._workers_done.set()  # workers: exit once the queue is empty
         for t in workers:
             t.join(timeout=drain_timeout)
             if t.is_alive():
@@ -206,20 +389,33 @@ class ClusterServing:
         # a successor sharing the process registry) reports no phantom
         # queue depth; the high-water mark is preserved
         self._m_depth.set(0.0)
+        # drain (a): never assembled — still in _pending / native queue
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
+        # drain (b): assembled but never inferred — left in the internal
+        # batch queue because a worker timed out or stop raced dispatch
+        while True:
+            try:
+                ab = self._batch_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            pending.extend(ab.group)
         if pending:
             self._count(errors=len(pending), drained=len(pending))
             for p in pending:
-                self._reply(p, {"uuid": p.uuid, "trace": p.trace,
-                                "error": "server shutting down"}, None)
+                self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                     "error": "server shutting down"},
+                                 None)
             logger.info("ClusterServing.stop: drained %d pending "
                         "request(s)", len(pending))
-        # only now close client connections: the drain replies above must
-        # reach their sockets first
+        # flush per-connection reply writers BEFORE closing sockets: the
+        # drain replies above must reach their clients first
         with self._threads_lock:
+            writers = list(self._writers.values())
             conns = list(self._conns)
+        for w in writers:
+            w.close(timeout=drain_timeout)
         for c in conns:
             try:
                 c.shutdown(socket.SHUT_RDWR)
@@ -252,6 +448,9 @@ class ClusterServing:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
+        writer = _ConnWriter(conn, send_lock, self._m_reply)
+        with self._threads_lock:
+            self._writers[conn] = writer
         try:
             while not self._stop.is_set():
                 frame = protocol.recv_frame(conn)
@@ -270,7 +469,7 @@ class ClusterServing:
                 if arr is None:
                     # protocol-legal but not servable: a header-only frame
                     # has no tensor to batch — reject here rather than let
-                    # it poison the batcher thread
+                    # it poison the pipeline
                     self._count(errors=1)
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
@@ -286,9 +485,10 @@ class ClusterServing:
                     rid = self._next_id
                     self._next_id += 1
                     self._pending[rid] = _Pending(uid, arr, conn, send_lock,
-                                                  expires, trace=tid)
-                # occupancy BEFORE the push: the batcher may pop (and
-                # decrement) the instant push returns, and a +1 that
+                                                  writer, expires,
+                                                  trace=tid)
+                # occupancy BEFORE the push: the assembly stage may pop
+                # (and decrement) the instant push returns, and a +1 that
                 # lands after the -1 would miss the high-water mark
                 self._m_depth.add(1)
                 try:
@@ -314,11 +514,13 @@ class ClusterServing:
         finally:
             with self._threads_lock:
                 self._conns.discard(conn)
+                self._writers.pop(conn, None)
+            writer.close()
             conn.close()
 
-    # -- stage 2: batch + infer ----------------------------------------------
+    # -- stage 2: batch assembly ----------------------------------------------
 
-    def _batch_loop(self) -> None:
+    def _assembly_loop(self) -> None:
         while not self._stop.is_set():
             batch: List[_Pending] = []
             try:
@@ -343,16 +545,88 @@ class ClusterServing:
                 if item is None:
                     break
                 batch.append(self._take(item[0]))
+            # injected latency (armed spec's ``delay``) lands HERE, in
+            # the single ordered stage, before shedding — so an armed
+            # delay holds the queue (and expires queued deadlines)
+            # exactly as the pre-pipeline batcher did, regardless of how
+            # many inference workers are idle
+            self._faults.fire("serving.model_latency")
             batch = self._shed_expired([p for p in batch if p is not None])
             if not batch:
                 continue
+            self._assemble_and_dispatch(batch)
+
+    def _assemble_and_dispatch(self, batch: List[_Pending]) -> None:
+        """Group by input shape (mixed-shape requests can't stack), stage
+        each group's rows into a pooled buffer, and hand the assembled
+        batches to the inference workers."""
+        groups: Dict[Tuple, List[_Pending]] = {}
+        for p in batch:
+            groups.setdefault(tuple(p.arr.shape) + (str(p.arr.dtype),),
+                              []).append(p)
+        now = time.monotonic()
+        for key, group in groups.items():
+            t0 = time.monotonic()
+            buf_key, buf = self._acquire_buf(group[0].arr.shape,
+                                             group[0].arr.dtype)
+            for i, p in enumerate(group):
+                buf[i] = p.arr  # row copy into the reused staging buffer
+                p.wait_ms = (now - p.enq_t) * 1000.0
+                self._m_queue_wait.observe(p.wait_ms)
+            assembly_ms = (time.monotonic() - t0) * 1000.0
+            self._m_assembly.observe(assembly_ms)
+            ab = _AssembledBatch(group, buf[:len(group)], buf_key, buf,
+                                 assembly_ms)
+            if not self._dispatch(ab):
+                # stopping and nobody will run it: explicit drain reply
+                self._release_buf(ab)
+                self._count(errors=len(group), drained=len(group))
+                for p in group:
+                    self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                         "error": "server shutting down"},
+                                     None)
+
+    def _dispatch(self, ab: _AssembledBatch) -> bool:
+        """Blocking put with a bounded post-stop grace window (workers
+        keep draining during stop, so a full queue usually clears)."""
+        stop_deadline: Optional[float] = None
+        while True:
             try:
-                self._run_batch(batch)
-            except Exception as e:  # noqa: BLE001 — batcher must survive
-                logger.warning("batch failed: %s", e)
-                self._count(errors=len(batch))
-                for p in batch:
-                    self._reply(p, {"uuid": p.uuid, "error": str(e)}, None)
+                self._batch_q.put(ab, timeout=0.25)
+                return True
+            except queue_mod.Full:
+                if not self._stop.is_set():
+                    continue
+                if stop_deadline is None:
+                    stop_deadline = time.monotonic() + 2.0
+                elif time.monotonic() > stop_deadline:
+                    return False
+
+    def _acquire_buf(self, shape: Tuple[int, ...],
+                     dtype: Any) -> Tuple[Tuple, np.ndarray]:
+        """A staging buffer with capacity for a full batch of this
+        shape, reused across batches (pool bounded by
+        ``staging_pool``); the pool-miss path allocates fresh."""
+        key = (tuple(shape), str(dtype))
+        with self._staging_lock:
+            free = self._staging.get(key)
+            if free:
+                return key, free.pop()
+        return key, np.empty((self.batch_size,) + tuple(shape),
+                             dtype=dtype)
+
+    def _release_buf(self, ab: _AssembledBatch) -> None:
+        """Return ``ab``'s staging buffer to the pool — idempotent (error
+        paths may race the success path's release; the same ndarray must
+        never sit in the pool twice, or two later assemblies would stage
+        different batches into shared bytes)."""
+        buf, ab.buf = ab.buf, None
+        if buf is None:
+            return
+        with self._staging_lock:
+            free = self._staging.setdefault(ab.buf_key, [])
+            if len(free) < self.staging_pool:
+                free.append(buf)
 
     def _take(self, rid_bytes: bytes) -> Optional[_Pending]:
         rid = int.from_bytes(rid_bytes, "big")
@@ -383,62 +657,105 @@ class ClusterServing:
                         shed_batches=1)
             self._m_shed_per_batch.observe(len(expired))
             for p in expired:
-                self._reply(p, {"uuid": p.uuid, "trace": p.trace,
-                                "error": "deadline exceeded"}, None)
+                self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                     "error": "deadline exceeded"}, None)
         return live
 
-    def _run_batch(self, batch: List[_Pending]) -> None:
-        # injected latency (armed spec's ``delay``) lands here, before the
-        # model call — the knob deadline/shedding tests turn
-        self._faults.fire("serving.model_latency")
-        # group by input shape (mixed-shape requests can't stack)
-        groups: Dict[Tuple, List[_Pending]] = {}
-        for p in batch:
-            groups.setdefault(tuple(p.arr.shape) + (str(p.arr.dtype),),
-                              []).append(p)
-        now = time.monotonic()
-        for _, group in groups.items():
-            x = np.stack([p.arr for p in group])
-            self._count(batches=1, batch_rows=len(group))
-            self._m_batch_size.observe(len(group))
-            for p in group:
-                self._m_queue_wait.observe((now - p.enq_t) * 1000.0)
-            t_inf = time.monotonic()
-            try:
-                out = self.model.predict(x)
-                infer_ms = (time.monotonic() - t_inf) * 1000.0
-                self._m_infer.observe(infer_ms)
-                # count BEFORE sending: a client that reacts to the
-                # reply must already see consistent counters in stats()
-                # (requests == replies + errors + pending at all times)
-                self._count(replies=len(group))
-                for p, row in zip(group, out):
-                    stages = None
-                    if p.trace is not None:
-                        # per-stage breakdown rides the reply header so
-                        # the client can answer "where did the latency
-                        # go?" without a second round trip
-                        stages = {
-                            "server.queue_wait_ms":
-                                round((now - p.enq_t) * 1000.0, 3),
-                            "server.inference_ms": round(infer_ms, 3),
-                            "server.batch_size": len(group)}
-                        trace_lib.record(p.trace, "server.batch", stages)
-                    self._reply(p, {"uuid": p.uuid, "trace": p.trace,
-                                    "stages": stages}, row)
-            except Exception as e:  # noqa: BLE001 — report to the client
-                logger.warning("inference failed: %s", e)
-                self._count(errors=len(group))
-                for p in group:
-                    self._reply(p, {"uuid": p.uuid, "trace": p.trace,
-                                    "error": str(e)}, None)
+    # -- stage 3: inference workers --------------------------------------------
 
-    def _reply(self, p: _Pending, header: Dict[str, Any],
-               arr: Optional[np.ndarray]) -> None:
+    def _worker_loop(self, wid: int) -> None:
+        # exit check at the TOP: on stop() a worker finishes the batch it
+        # is running and returns — batches still queued get an explicit
+        # "server shutting down" drain reply instead of late inference
+        while not self._workers_done.is_set():
+            try:
+                ab = self._batch_q.get(timeout=0.25)
+            except queue_mod.Empty:
+                continue
+            try:
+                self._run_batch(ab)
+            except Exception as e:  # noqa: BLE001 — workers must survive
+                logger.warning("batch failed: %s", e)
+                self._release_buf(ab)
+                self._count(errors=len(ab.group))
+                for p in ab.group:
+                    self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                         "error": str(e)}, None)
+
+    def _run_batch(self, ab: _AssembledBatch) -> None:
+        # a batch can sit in the internal queue past its rows' deadlines:
+        # re-shed here so inference never runs for a departed client
+        group = self._shed_expired(ab.group)
+        if not group:
+            self._release_buf(ab)
+            return
+        x = ab.x
+        if len(group) < len(ab.group):
+            # re-shed dropped rows: re-stage the survivors so row i of
+            # the model input is row i of ``group`` — predicting on the
+            # stale full buffer would zip survivors with OTHER requests'
+            # outputs (silently wrong answers)
+            buf = ab.buf if ab.buf is not None else np.empty(
+                (self.batch_size,) + group[0].arr.shape,
+                dtype=group[0].arr.dtype)
+            for i, p in enumerate(group):
+                buf[i] = p.arr
+            x = buf[:len(group)]
+        self._count(batches=1, batch_rows=len(group))
+        self._m_batch_size.observe(len(group))
+        t_inf = time.monotonic()
+        try:
+            out = np.asarray(self.model.predict(x))
+            infer_ms = (time.monotonic() - t_inf) * 1000.0
+            if np.may_share_memory(out, x):
+                # a pass-through-ish model returned (a view of) its
+                # input: the reply rows would alias the staging buffer,
+                # which the pool is about to hand to the next assembly —
+                # copy before releasing
+                out = out.copy()
+            self._release_buf(ab)
+            self._m_infer.observe(infer_ms)
+            # count BEFORE sending: a client that reacts to the
+            # reply must already see consistent counters in stats()
+            # (requests == replies + errors + pending at all times)
+            self._count(replies=len(group))
+            for p, row in zip(group, out):
+                stages = None
+                if p.trace is not None:
+                    # per-stage breakdown rides the reply header so
+                    # the client can answer "where did the latency
+                    # go?" without a second round trip
+                    stages = {
+                        "server.queue_wait_ms": round(p.wait_ms, 3),
+                        "server.assembly_ms": round(ab.assembly_ms, 3),
+                        "server.inference_ms": round(infer_ms, 3),
+                        "server.batch_size": len(group)}
+                    trace_lib.record(p.trace, "server.batch", stages)
+                self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                     "stages": stages}, row)
+        except Exception as e:  # noqa: BLE001 — report to the client
+            logger.warning("inference failed: %s", e)
+            self._release_buf(ab)
+            self._count(errors=len(group))
+            for p in group:
+                self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                     "error": str(e)}, None)
+
+    # -- stage 4: reply delivery ------------------------------------------------
+
+    def _send_reply(self, p: _Pending, header: Dict[str, Any],
+                    arr: Optional[np.ndarray]) -> None:
+        """Hand the reply to the connection's writer stage; fall back to
+        a best-effort inline send when the writer is gone (connection
+        closing, or stop() already flushed it)."""
+        if p.writer is not None and p.writer.push(header, arr):
+            return
         try:
             with p.lock:
-                protocol.send_frame(p.conn, protocol.encode(header, arr))
-        except OSError:
+                protocol.send_frame_parts(p.conn,
+                                          protocol.encode_parts(header,
+                                                                arr))
+        except (OSError, ValueError):
             pass  # client went away
 
 
@@ -456,13 +773,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8980)
     parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--inference-workers", type=int, default=None,
+                        help="concurrent model-call threads (default: "
+                             "ZooConfig.inference_workers, 2)")
     parser.add_argument("--http-port", type=int, default=None,
                         help="also serve HTTP/JSON on this port")
     args = parser.parse_args(argv)
 
     model = InferenceModel().load_zoo_model(args.model_dir)
     serving = ClusterServing(model, host=args.host, port=args.port,
-                             batch_size=args.batch_size).start()
+                             batch_size=args.batch_size,
+                             inference_workers=args.inference_workers
+                             ).start()
     frontend = None
     if args.http_port is not None:
         from .http_frontend import HTTPFrontend

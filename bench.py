@@ -80,7 +80,7 @@ _PEAK_BF16 = [
 # records only the tail of stdout, so the records that carry the
 # acceptance-bar evidence must be the final lines (the round-4 artifact
 # lost the opening of its first-printed record to tail truncation).
-CONFIGS = ("lenet", "ncf", "autots", "scaling", "serving",
+CONFIGS = ("lenet", "ncf", "autots", "scaling", "serving", "pipeline",
            "resnet50", "bert")
 
 
@@ -923,6 +923,145 @@ def bench_serving() -> None:
                    "floor, QPS at conc=32 the batched throughput"})
 
 
+# -- pipelined hot paths (ISSUE 4) --------------------------------------------
+
+def bench_pipeline() -> None:
+    """Pipelined-hot-path evidence on a SMALL model (host overhead
+    dominant — the regime the pipeline exists for): (1) closed-loop
+    serving throughput + p50/p99 through the REAL TCP path at
+    ``inference_workers`` 1 vs 2, and (2) the training loop's
+    ``train.data_wait_ms`` p50 at ``fit(prefetch=)`` 0 vs 2 on a
+    deliberately throttled feed (armed ``feed.stall``).  The emitted
+    value is the serving QPS speedup (workers 2 / workers 1);
+    vs_baseline is 1.0 only when BOTH wins materialized.
+
+    Caveat the record carries explicitly: overlapping two inference
+    calls needs either an accelerator (host threads overlap device
+    compute) or >= 2 host cores (XLA:CPU compute-vs-compute cannot
+    overlap on one core — only idle time, e.g. the batch window or a
+    device round trip, is overlappable there).  The prefetch half's win
+    is demonstrable anywhere, because a throttled feed's stall IS idle
+    time."""
+    import multiprocessing
+
+    import jax
+    import numpy as np
+
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.core import faults, init_orca_context
+    from analytics_zoo_tpu.core import metrics as metrics_lib
+    from analytics_zoo_tpu.orca.learn import Estimator
+    from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
+                                           InputQueue, OutputQueue)
+
+    init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+    rng = np.random.default_rng(0)
+
+    # -- serving: closed-loop sweep, workers 1 vs 2 -------------------------
+    model = nn.Sequential([nn.Dense(512, activation="relu"),
+                           nn.Dense(512, activation="relu"),
+                           nn.Dense(64)])
+    x0 = rng.normal(size=(16, 256)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0)
+    one = x0[0]
+
+    def closed_loop(workers: int, clients: int = 8,
+                    duration_s: float = 4.0) -> dict:
+        im = InferenceModel(batch_buckets=(1, 4, 8, 16)).load(model,
+                                                              variables)
+        im.predict(x0)          # warm every bucket the sweep can hit
+        im.predict(x0[:1]); im.predict(x0[:4]); im.predict(x0[:8])
+        lat, errs = [], []
+        with ClusterServing(im, batch_size=16, batch_timeout_ms=2,
+                            inference_workers=workers) as srv:
+            deadline = time.perf_counter() + duration_s
+
+            def client(i):
+                try:
+                    iq = InputQueue(port=srv.port)
+                    oq = OutputQueue(input_queue=iq)
+                    while time.perf_counter() < deadline:
+                        t0 = time.perf_counter()
+                        uid = iq.enqueue(f"c{i}", t=one)
+                        if oq.query(uid, timeout=60.0) is None:
+                            raise RuntimeError("request timed out")
+                        lat.append(time.perf_counter() - t0)
+                    iq.close()
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errs.append(f"{type(e).__name__}: {e}"[:200])
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            srv_stats = srv.stats()
+        out = {"client_errors": len(errs)} if errs else {}
+        if lat:
+            ms = np.sort(np.asarray(lat)) * 1000
+            out.update({
+                "qps": round(len(lat) / wall, 1),
+                "p50_ms": round(float(ms[len(ms) // 2]), 2),
+                "p99_ms": round(float(ms[min(len(ms) - 1,
+                                             int(len(ms) * 0.99))]), 2),
+                "mean_batch_size": round(srv_stats["mean_batch_size"], 2),
+            })
+        return out
+
+    serving = {"workers_1": closed_loop(1), "workers_2": closed_loop(2)}
+    qps1 = serving["workers_1"].get("qps", 0.0)
+    qps2 = serving["workers_2"].get("qps", 0.0)
+    speedup = qps2 / qps1 if qps1 else 0.0
+
+    # -- training: data-wait at prefetch 0 vs 2 on a throttled feed ---------
+    xt = rng.normal(size=(4096, 256)).astype(np.float32)
+    yt = rng.normal(size=(4096, 1)).astype(np.float32)
+
+    def data_wait(prefetch: int) -> dict:
+        est = Estimator.from_keras(
+            nn.Sequential([nn.Dense(512, activation="relu"),
+                           nn.Dense(512, activation="relu"),
+                           nn.Dense(1)]),
+            loss="mse", learning_rate=1e-3, seed=0)
+        est.fit((xt, yt), epochs=1, batch_size=256, verbose=False,
+                prefetch=prefetch)  # compile outside the clock
+        metrics_lib.get_registry().reset()
+        t0 = time.perf_counter()
+        with faults.get_registry().armed("feed.stall", delay=0.004):
+            est.fit((xt, yt), epochs=2, batch_size=256, verbose=False,
+                    prefetch=prefetch)
+        wall = time.perf_counter() - t0
+        snap = metrics_lib.get_registry().snapshot()
+        h = snap["train.data_wait_ms"]
+        return {"data_wait_p50_ms": round(h["p50"], 3),
+                "data_wait_p99_ms": round(h["p99"], 3),
+                "step_p50_ms": round(snap["train.step_ms"]["p50"], 3),
+                "samples_per_sec": round(2 * len(xt) / wall, 1)}
+
+    train = {"prefetch_0": data_wait(0), "prefetch_2": data_wait(2)}
+    wait_dropped = (train["prefetch_2"]["data_wait_p50_ms"]
+                    < train["prefetch_0"]["data_wait_p50_ms"])
+
+    host_cores = multiprocessing.cpu_count()
+    clean = (speedup > 1.0 and wait_dropped
+             and not any("client_errors" in s for s in serving.values()))
+    _emit("pipeline_serving_speedup", speedup,
+          "x (closed-loop QPS, inference_workers 2 vs 1)",
+          1.0 if clean else 0.0,
+          {"serving": serving, "train": train,
+           "feed_stall_ms": 4.0, "chips": n_chips, "device_kind": kind,
+           "host_cores": host_cores,
+           "note": "serving sweep: 8 closed-loop clients, server batch "
+                   "16, small Dense model; on a 1-core CPU-only host "
+                   "the serving speedup is structurally ~1.0 (no second "
+                   "core / device to overlap compute onto) — the "
+                   "prefetch data-wait drop is the portable win there"})
+
+
 # -- scaling ------------------------------------------------------------------
 
 def bench_scaling() -> None:
@@ -992,7 +1131,8 @@ def bench_scaling() -> None:
 
 _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "lenet": bench_lenet, "ncf": bench_ncf, "autots": bench_autots,
-            "scaling": bench_scaling, "serving": bench_serving}
+            "scaling": bench_scaling, "serving": bench_serving,
+            "pipeline": bench_pipeline}
 
 
 # Per-config child budget: (timeout seconds per attempt, max attempts).
@@ -1001,7 +1141,7 @@ _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
 # bounded — the cheap configs get a shorter leash than the two MFU configs.
 _BUDGET = {"bert": (1800, 3), "resnet50": (1800, 3), "lenet": (900, 2),
            "ncf": (900, 2), "autots": (1800, 2), "scaling": (1200, 2),
-           "serving": (1800, 2)}
+           "serving": (1800, 2), "pipeline": (900, 2)}
 
 
 def _device_preflight(max_wait_s: int = 1500,

@@ -225,3 +225,84 @@ class TestStreamingResilience:
         with pytest.raises(ValueError, match="retries"):
             StreamingDataFeed(8, lambda i, rng=None: {}, batch_size=4,
                               retries=-1)
+
+
+class TestPrefetchIterator:
+    """Background feed lookahead (the training half of the pipelined hot
+    path): order, exception propagation, and mid-epoch shutdown."""
+
+    def test_order_preserved_and_complete(self):
+        from analytics_zoo_tpu.data import PrefetchIterator
+        items = [np.full((3,), float(i)) for i in range(17)]
+        got = list(PrefetchIterator(iter(items), depth=2))
+        assert len(got) == 17
+        for i, a in enumerate(got):
+            np.testing.assert_array_equal(a, items[i])
+
+    def test_producer_exception_reraises_in_consumer(self):
+        from analytics_zoo_tpu.data import PrefetchIterator
+
+        def gen():
+            yield 1
+            yield 2
+            raise OSError("loader died")
+
+        it = PrefetchIterator(gen(), depth=2)
+        assert next(it) == 1 and next(it) == 2
+        with pytest.raises(OSError, match="loader died"):
+            next(it)
+        # after the error the iterator is exhausted, not wedged
+        assert next(it, None) is None
+
+    def test_close_mid_epoch_stops_producer(self):
+        import itertools
+        import threading
+        from analytics_zoo_tpu.data import PrefetchIterator
+        produced = []
+
+        def gen():
+            for i in itertools.count():
+                produced.append(i)
+                yield i
+
+        it = PrefetchIterator(gen(), depth=2)
+        assert next(it) == 0
+        it.close()
+        n_threads = threading.active_count()
+        it.close()  # idempotent
+        assert threading.active_count() == n_threads
+        # the producer stopped near the depth bound, not at infinity
+        assert len(produced) <= 8
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_depth_validated(self):
+        from analytics_zoo_tpu.data import PrefetchIterator
+        with pytest.raises(ValueError, match="depth"):
+            PrefetchIterator(iter([]), depth=0)
+
+    def test_overlaps_slow_feed_with_slow_consumer(self):
+        """With depth-2 double buffering, a feed taking F per batch and a
+        consumer taking C per step run in ~max(F, C) per item, not F+C —
+        the wall-clock proof that host feed work overlaps consumption."""
+        import time as _t
+        from analytics_zoo_tpu.data import PrefetchIterator
+
+        def slow_feed(n=8, per=0.03):
+            for i in range(n):
+                _t.sleep(per)
+                yield i
+
+        # inline baseline: feed + consume serialize
+        t0 = _t.monotonic()
+        for _ in slow_feed():
+            _t.sleep(0.03)
+        inline = _t.monotonic() - t0
+
+        t0 = _t.monotonic()
+        it = PrefetchIterator(slow_feed(), depth=2)
+        for _ in it:
+            _t.sleep(0.03)
+        overlapped = _t.monotonic() - t0
+        # ~0.48s inline vs ~0.27s overlapped; generous margin for CI noise
+        assert overlapped < inline * 0.8, (inline, overlapped)

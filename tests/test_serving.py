@@ -533,6 +533,457 @@ def test_ws_conv_stays_weight_only_under_calibration():
     assert np.max(np.abs(out_q - out_ref) / denom) < 0.2
 
 
+# -- pipelined hot path (assembly → inference workers → reply writers) --------
+
+class _PipeModel:
+    """Stub with declared concurrency for pipelined-server tests: doubles
+    its input, counts rows actually inferred, optional per-batch delay."""
+
+    concurrent_num = 4
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def predict(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        with self._lock:
+            self.calls.append(np.asarray(x).shape[0])
+        return np.asarray(x) * 2.0
+
+    @property
+    def rows_seen(self) -> int:
+        with self._lock:
+            return sum(self.calls)
+
+
+def test_pipelined_mixed_shape_concurrent_clients():
+    """inference_workers=2: concurrent clients with two feature shapes all
+    get their own (correct) answer — shape groups may infer concurrently
+    on different workers, replies still key by uuid."""
+    with ClusterServing(_PipeModel(), batch_size=8, batch_timeout_ms=10,
+                        inference_workers=2) as srv:
+        assert srv.inference_workers == 2
+        results, errors = {}, []
+
+        def client(i):
+            try:
+                iq = InputQueue(srv.host, srv.port)
+                oq = OutputQueue(input_queue=iq)
+                shape = (4,) if i % 2 else (7,)
+                x = np.full(shape, float(i), np.float32)
+                uid = iq.enqueue(f"c{i}", t=x)
+                results[i] = (shape, oq.query(uid, timeout=30.0))
+                iq.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 16
+        for i, (shape, out) in results.items():
+            assert out.shape == shape
+            np.testing.assert_allclose(out, np.full(shape, 2.0 * i),
+                                       rtol=1e-6)
+        s = srv.stats()
+    assert s["requests"] == 16
+    assert s["requests"] == s["replies"] + s["errors"] + s["pending"]
+
+
+def test_stats_invariant_under_two_workers():
+    """requests == replies + errors + pending must survive the pipelined
+    restructure with concurrent inference workers."""
+    with ClusterServing(_PipeModel(), batch_size=4, batch_timeout_ms=5,
+                        inference_workers=2) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uids = [iq.enqueue(f"i{k}", t=np.full((6,), float(k), np.float32))
+                for k in range(20)]
+        for uid in uids:
+            assert oq.query(uid, timeout=30.0) is not None
+        s = srv.stats()
+        iq.close()
+    assert s["requests"] == 20 and s["pending"] == 0
+    assert s["requests"] == s["replies"] + s["errors"] + s["pending"]
+    assert s["inference_workers"] == 2
+
+
+def test_slow_reading_client_does_not_stall_inference():
+    """A client that stops reading its replies (tiny receive buffer, big
+    tensors) blocks only its own connection's reply writer: other
+    clients' requests keep flowing through assembly → inference → reply,
+    and the slow client's own rows still get INFERRED (replies parked in
+    its writer queue), because sendall no longer runs on the batcher."""
+    import socket
+    model = _PipeModel()
+    rows = 16
+    big = np.ones((262144,), np.float32)  # 1 MiB per request/reply
+    with ClusterServing(model, batch_size=2, batch_timeout_ms=2,
+                        inference_workers=2) as srv:
+        slow = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # shrink the receive window BEFORE connect so the server-side
+        # sendall hits backpressure after a few replies
+        slow.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+        slow.connect((srv.host, srv.port))
+        try:
+            for i in range(rows):
+                protocol.send_frame(slow,
+                                    protocol.encode({"uuid": f"slow-{i}"},
+                                                    big))
+            # ... and never read a single reply.
+            # meanwhile a well-behaved client must round-trip promptly
+            iq = InputQueue(srv.host, srv.port)
+            oq = OutputQueue(input_queue=iq)
+            t0 = time.monotonic()
+            for k in range(8):
+                uid = iq.enqueue(f"fast-{k}",
+                                 t=np.full((8,), float(k), np.float32))
+                out = oq.query(uid, timeout=30.0)
+                np.testing.assert_allclose(out, np.full((8,), 2.0 * k),
+                                           rtol=1e-6)
+            fast_elapsed = time.monotonic() - t0
+            assert fast_elapsed < 20.0
+            # the slow client's rows were all inferred too — its replies
+            # are queued/blocked in ITS writer, not holding the model
+            deadline = time.monotonic() + 20.0
+            while (model.rows_seen < rows + 8
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert model.rows_seen == rows + 8, model.rows_seen
+            # counters are final pre-send: replies counts the stuck ones
+            s = srv.stats()
+            assert s["replies"] == rows + 8
+            assert s["requests"] == s["replies"] + s["errors"] + s["pending"]
+            iq.close()
+        finally:
+            slow.close()
+
+
+def test_stop_drains_assembled_batches_in_internal_queue():
+    """stop() with work at EVERY pipeline depth: the in-flight batch
+    finishes, batches waiting in the internal assembled-batch queue and
+    requests still in the native queue all get the explicit
+    "server shutting down" reply — no hung queries, invariant intact."""
+    from analytics_zoo_tpu.serving.client import RetryPolicy
+    model = _PipeModel(delay=0.3)
+    srv = ClusterServing(model, batch_size=1, batch_timeout_ms=1,
+                         inference_workers=1).start()
+    iq = InputQueue(srv.host, srv.port, retry=RetryPolicy(max_attempts=1))
+    oq = OutputQueue(input_queue=iq)
+    x = np.arange(4, dtype=np.float32)
+    uids = [iq.enqueue(f"d{i}", t=x) for i in range(6)]
+    time.sleep(0.15)  # first batch is inside the model; rest are staged
+    outcomes = {}
+
+    def drain_query(uid):
+        try:
+            outcomes[uid] = ("ok", oq.query(uid, timeout=15.0))
+        except RuntimeError as e:
+            outcomes[uid] = ("error", str(e))
+
+    threads = [threading.Thread(target=drain_query, args=(u,))
+               for u in uids]
+    for t in threads:
+        t.start()
+    srv.stop()
+    for t in threads:
+        t.join(timeout=20)
+    assert not any(t.is_alive() for t in threads), "hung query() calls"
+    assert len(outcomes) == 6
+    served = [u for u, (kind, _) in outcomes.items() if kind == "ok"]
+    drained = [u for u, (kind, msg) in outcomes.items()
+               if kind == "error" and "server shutting down" in msg]
+    assert len(served) + len(drained) == 6, outcomes
+    # inference_workers=1 and one batch takes 0.3s: most of the queue
+    # (native + internal assembled) must have been drained, not served
+    assert len(drained) >= 2
+    s = srv.stats()
+    assert s["drained"] == len(drained)
+    assert s["requests"] == s["replies"] + s["errors"] + s["pending"] == 6
+    iq.close()
+
+
+def test_batch_error_reply_carries_trace_id():
+    """A whole-batch inference failure must include the trace id in its
+    error reply so traced clients can correlate the failure."""
+    import socket
+
+    class _Boom:
+        concurrent_num = 2
+
+        def predict(self, x):
+            raise ValueError("boom-batch")
+
+    with ClusterServing(_Boom(), batch_size=2) as srv:
+        raw = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            protocol.send_frame(raw, protocol.encode(
+                {"uuid": "traced-1", "trace": "feedbeeffeedbeef"},
+                np.ones((4,), np.float32)))
+            header, _ = protocol.decode(protocol.recv_frame(raw))
+            assert header["uuid"] == "traced-1"
+            assert "boom-batch" in header["error"]
+            assert header["trace"] == "feedbeeffeedbeef"
+        finally:
+            raw.close()
+
+
+def test_staging_buffers_are_reused_across_batches():
+    """Batch assembly stages rows into a pooled per-shape buffer instead
+    of a fresh np.stack: after sequential batches of one shape, the pool
+    holds at most `staging_pool` buffers and results stay correct."""
+    model = _PipeModel()
+    with ClusterServing(model, batch_size=4, batch_timeout_ms=2,
+                        inference_workers=1, staging_pool=2) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        for round_i in range(6):
+            uid = iq.enqueue(f"r{round_i}",
+                             t=np.full((5,), float(round_i), np.float32))
+            out = oq.query(uid, timeout=30.0)
+            np.testing.assert_allclose(out, np.full((5,), 2.0 * round_i),
+                                       rtol=1e-6)
+        key = ((5,), "float32")
+        with srv._staging_lock:
+            pool = list(srv._staging.get(key, []))
+        assert 1 <= len(pool) <= 2  # reused, bounded by staging_pool
+        iq.close()
+
+
+def test_worker_reshed_keeps_survivor_rows_aligned():
+    """Regression (review): a deadline that expires while a batch waits
+    in the INTERNAL queue sheds that row at the worker — the surviving
+    request must still get the prediction for ITS OWN input, not its
+    shed neighbor's (the batch is re-staged after the shed)."""
+    from analytics_zoo_tpu.serving.client import RetryPolicy
+    model = _PipeModel(delay=0.8)
+    with ClusterServing(model, batch_size=2, batch_timeout_ms=50,
+                        inference_workers=1) as srv:
+        iq = InputQueue(srv.host, srv.port,
+                        retry=RetryPolicy(max_attempts=1))
+        oq = OutputQueue(input_queue=iq)
+        # batch 1 fills immediately and occupies the single worker 0.8s
+        x1 = iq.enqueue("x1", t=np.full((4,), 10.0, np.float32))
+        x2 = iq.enqueue("x2", t=np.full((4,), 20.0, np.float32))
+        time.sleep(0.1)
+        # batch 2 = [doomed, survivor] waits in the internal queue while
+        # the worker is busy; doomed's 0.25s budget expires there
+        doomed = iq.enqueue("doomed", deadline=0.25,
+                            t=np.full((4,), 30.0, np.float32))
+        survivor = iq.enqueue("survivor",
+                              t=np.full((4,), 40.0, np.float32))
+        with pytest.raises(RuntimeError, match="deadline exceeded"):
+            oq.query(doomed, timeout=20.0)
+        out = oq.query(survivor, timeout=20.0)
+        # misaligned zip would deliver 2*30 (the shed row) here
+        np.testing.assert_allclose(out, np.full((4,), 80.0), rtol=1e-6)
+        assert oq.query(x1, timeout=20.0) is not None
+        assert oq.query(x2, timeout=20.0) is not None
+        # the shed row never ran inference: 2 (first batch) + 1 survivor
+        assert model.rows_seen == 3
+        s = srv.stats()
+        assert s["shed"] == 1
+        assert s["requests"] == s["replies"] + s["errors"] + s["pending"]
+        iq.close()
+
+
+def test_passthrough_model_replies_do_not_alias_staging_buffer():
+    """Regression (review): a model returning (a view of) its input must
+    not leave reply rows aliasing the pooled staging buffer — later
+    batches would overwrite queued replies.  Interleaved same-shape
+    requests with distinct payloads must each get their own echo."""
+
+    class _Identity:
+        concurrent_num = 2
+
+        def predict(self, x):
+            return x  # returns the staging-buffer view itself
+
+    with ClusterServing(_Identity(), batch_size=4, batch_timeout_ms=1,
+                        inference_workers=2, staging_pool=1) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uids = [(i, iq.enqueue(f"e{i}",
+                               t=np.full((16,), float(i), np.float32)))
+                for i in range(32)]
+        for i, uid in uids:
+            out = oq.query(uid, timeout=30.0)
+            np.testing.assert_array_equal(out, np.full((16,), float(i),
+                                                       np.float32))
+        iq.close()
+
+
+def test_failed_batch_does_not_double_release_staging_buffer():
+    """Regression (review): an exception AFTER the success-path buffer
+    release (e.g. a 0-d model output breaking the reply zip) must not
+    put the same buffer into the pool twice."""
+
+    class _ZeroD:
+        concurrent_num = 2
+
+        def __init__(self):
+            self.fail = True
+
+        def predict(self, x):
+            if self.fail:
+                return np.float32(3.0)  # zip() over 0-d raises
+            return np.asarray(x) * 2.0
+
+    model = _ZeroD()
+    with ClusterServing(model, batch_size=2, inference_workers=1,
+                        staging_pool=4) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        with pytest.raises(RuntimeError):
+            oq.query(iq.enqueue("bad", t=np.ones((4,), np.float32)),
+                     timeout=20.0)
+        model.fail = False
+        out = oq.query(iq.enqueue("good", t=np.ones((4,), np.float32)),
+                       timeout=20.0)
+        np.testing.assert_allclose(out, np.full((4,), 2.0), rtol=1e-6)
+        key = ((4,), "float32")
+        with srv._staging_lock:
+            pool = list(srv._staging.get(key, []))
+        assert len(set(map(id, pool))) == len(pool), "duplicate buffer"
+        iq.close()
+
+
+def test_writer_overflow_drops_dead_client_not_workers(monkeypatch):
+    """Regression (review): a client whose reply queue stays full past
+    the push grace is DROPPED — the shared inference workers (and a
+    later stop()) must never block forever on one dead connection."""
+    import socket
+    from analytics_zoo_tpu.serving.server import _ConnWriter
+    monkeypatch.setattr(_ConnWriter, "MAX_ITEMS", 8)
+    monkeypatch.setattr(_ConnWriter, "PUSH_GRACE_S", 0.2)
+    model = _PipeModel()
+    with ClusterServing(model, batch_size=4, batch_timeout_ms=1,
+                        inference_workers=2) as srv:
+        dead = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        dead.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        dead.connect((srv.host, srv.port))
+        big = np.ones((65536,), np.float32)  # 256 KiB replies
+        try:
+            for i in range(24):  # >> queue bound + socket buffers
+                protocol.send_frame(dead,
+                                    protocol.encode({"uuid": f"n{i}"},
+                                                    big))
+            # a healthy client keeps round-tripping while (and after)
+            # the dead one overflows and gets dropped
+            iq = InputQueue(srv.host, srv.port)
+            oq = OutputQueue(input_queue=iq)
+            for k in range(6):
+                uid = iq.enqueue(f"h{k}",
+                                 t=np.full((8,), float(k), np.float32))
+                out = oq.query(uid, timeout=30.0)
+                np.testing.assert_allclose(out, np.full((8,), 2.0 * k),
+                                           rtol=1e-6)
+                time.sleep(0.1)
+            iq.close()
+        finally:
+            dead.close()
+        srv.stop()  # must return promptly, not deadlock on the drain
+    s = srv.stats()
+    assert s["requests"] == s["replies"] + s["errors"] + s["pending"]
+
+
+# -- zero-copy protocol --------------------------------------------------------
+
+def test_encode_parts_matches_encode_and_decodes():
+    header = {"uuid": "zc-1", "trace": "0123456789abcdef"}
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    joined = b"".join(protocol.encode_parts(header, arr))
+    assert joined == protocol.encode(header, arr)
+    got_header, got = protocol.decode(bytearray(joined[4:]))
+    assert got_header["uuid"] == "zc-1"
+    np.testing.assert_array_equal(got, arr)
+    # non-contiguous input still encodes its logical content
+    nc = np.arange(32, dtype=np.float32).reshape(8, 4)[::2]
+    _, got_nc = protocol.decode(
+        bytearray(b"".join(protocol.encode_parts({"uuid": "z"}, nc))[4:]))
+    np.testing.assert_array_equal(got_nc, nc)
+
+
+def test_send_frame_parts_handles_partial_sends():
+    """Scatter-gather send must survive partial sendmsg returns (small
+    socket buffers + a large tensor): the peer reassembles the exact
+    frame."""
+    import socket
+    a, b = socket.socketpair()
+    try:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        arr = np.random.default_rng(0).normal(
+            size=(1024, 64)).astype(np.float32)  # 256 KiB payload
+        parts = protocol.encode_parts({"uuid": "big"}, arr)
+        sender = threading.Thread(
+            target=protocol.send_frame_parts, args=(a, parts))
+        sender.start()
+        frame = protocol.recv_frame(b)
+        sender.join(timeout=10)
+        assert not sender.is_alive()
+        header, got = protocol.decode(frame)
+        assert header["uuid"] == "big"
+        np.testing.assert_array_equal(got, arr)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_rejects_oversized_length(monkeypatch):
+    """SATELLITE: a corrupt/malicious 4-byte length must be rejected
+    BEFORE any allocation (configurable MAX_FRAME_BYTES), not answered
+    with a multi-GiB bytearray attempt."""
+    import socket
+    import struct
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", protocol.MAX_FRAME_BYTES + 1))
+        with pytest.raises(ValueError, match="MAX_FRAME_BYTES"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # the bound is configurable: a legitimate frame over a lowered bound
+    # is rejected the same way
+    monkeypatch.setattr(protocol, "MAX_FRAME_BYTES", 64)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(protocol.encode({"uuid": "x"},
+                                  np.zeros((64,), np.float32)))
+        with pytest.raises(ValueError, match="MAX_FRAME_BYTES"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_survives_oversized_frame_then_serves(inference_model):
+    """An oversized length prefix kills that connection only; the server
+    keeps serving well-formed clients."""
+    import socket
+    import struct
+    with ClusterServing(inference_model, batch_size=2) as srv:
+        raw = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            raw.sendall(struct.pack(">I", protocol.MAX_FRAME_BYTES + 7))
+            raw.settimeout(10)
+            assert raw.recv(1) == b""  # server closed the connection
+        finally:
+            raw.close()
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        uid = iq.enqueue("ok", t=np.ones(4, np.float32))
+        assert oq.query(uid, timeout=20.0) is not None
+        iq.close()
+
+
 def test_save_load_executables_roundtrip(tmp_path):
     """Serialized AOT artifacts (reference: OpenVINO IR) round-trip: a
     fresh InferenceModel loads them, skips tracing, and predicts the
