@@ -29,6 +29,17 @@ the generation — last, after a cross-host barrier.  A kill at any point
 leaves the previous checkpoint fully intact (its generation's files are
 never touched); stale generations are garbage-collected only after the new
 meta is visible.
+
+Integrity (ISSUE 5): ``save`` records a crc32 per data file
+(``arrays_<gen>.npz`` and every ``shards_<gen>_p<i>.npz``) in the meta,
+and ``restore`` verifies each file against it before trusting its bytes
+— silent storage bit-rot surfaces as a :class:`CheckpointCorruptError`
+NAMING the bad file (and bumps the ``checkpoint.corrupt_files``
+counter) instead of as NaNs three epochs later.  With ``save(keep=2)``
+the previous complete generation's files AND meta
+(``treedef.prev.json``) survive the new save, so a corrupt latest
+generation falls back to the previous one with a WARNING rather than
+losing the run.
 """
 
 from __future__ import annotations
@@ -38,7 +49,8 @@ import logging
 import os
 import tempfile
 import time
-from typing import Any, Callable, Optional
+import zlib
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -49,7 +61,47 @@ from . import metrics as metrics_lib
 logger = logging.getLogger("analytics_zoo_tpu")
 
 _META = "treedef.json"
+_PREV_META = "treedef.prev.json"
 _DATA = "arrays.npz"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint data file's bytes do not match the crc32 recorded at
+    save time (or the file vanished).  The message names the file."""
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _verify_crc(path: str, name: str, crcs: Optional[Dict[str, int]]
+                ) -> None:
+    """Check one data file against the crc recorded at save time.  A
+    file with no recorded crc (pre-integrity checkpoints) passes — the
+    guarantee is only as old as the save that wrote it."""
+    want = (crcs or {}).get(name)
+    if want is None:
+        return
+    full = os.path.join(path, name)
+    try:
+        got = _crc32_file(full)
+    except OSError as e:
+        metrics_lib.get_registry().inc("checkpoint.corrupt_files")
+        raise CheckpointCorruptError(
+            f"checkpoint data file {name!r} in {path} is unreadable: {e}"
+        ) from e
+    if got != int(want):
+        metrics_lib.get_registry().inc("checkpoint.corrupt_files")
+        raise CheckpointCorruptError(
+            f"checkpoint data file {name!r} in {path} is corrupt: "
+            f"crc32 {got:#010x} != recorded {int(want):#010x}")
 
 
 def _write_with_retry(fn: Callable[[], None], what: str, retries: int,
@@ -124,7 +176,7 @@ def _key_to_index(key: str) -> tuple:
 
 def save(path: str, tree: Any, step: Optional[int] = None,
          extra: Optional[dict] = None, retries: int = 3,
-         retry_delay: float = 0.05) -> str:
+         retry_delay: float = 0.05, keep: int = 1) -> str:
     """Write ``tree`` under directory ``path`` (created if needed).
 
     Multi-host: every process must call this.  Each process writes ONLY the
@@ -138,6 +190,13 @@ def save(path: str, tree: Any, step: Optional[int] = None,
     process retries its own files independently; the cross-host barriers
     sit after the retried sections, so a process that needed three
     attempts just arrives at the barrier late).
+
+    ``keep``: generations retained on disk.  The default 1 keeps only
+    the new save (the pre-existing behavior); ``keep=2`` preserves the
+    previous complete generation — its data files AND its meta (as
+    ``treedef.prev.json``) — so a later ``restore`` that finds the
+    latest generation corrupt (crc mismatch) can fall back instead of
+    failing the run.
     """
     t_save = time.monotonic()
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -194,19 +253,31 @@ def save(path: str, tree: Any, step: Optional[int] = None,
     # generation, so a kill at ANY point leaves the previous checkpoint's
     # files untouched and its meta still pointing at them.
     gen = _new_generation(pidx, pcount)
+    crcs: Dict[str, int] = {}   # data file name -> crc32, lands in meta
+    my_crc = 0
     if my_shards or pcount > 1:
         def _write_shards() -> None:
+            nonlocal my_crc
             fd, tmp_sh = tempfile.mkstemp(dir=path, suffix=f".p{pidx}.tmp")
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, **my_shards)
+            my_crc = _crc32_file(tmp_sh)  # crc what was actually written
             os.replace(tmp_sh, os.path.join(path, _shards_name(gen, pidx)))
 
         _write_with_retry(_write_shards, f"shards p{pidx}", retries,
                           retry_delay)
+        if pcount == 1:
+            crcs[_shards_name(gen, pidx)] = my_crc
     if pcount > 1:
         from jax.experimental import multihost_utils
         # all shard files must be complete before meta becomes visible
         multihost_utils.sync_global_devices("zoo_ckpt_shards_written")
+        # every process crc'd its own shard file; process 0 needs them
+        # all for the meta — one uint32 allgather over the DCN plane
+        all_crcs = np.asarray(multihost_utils.process_allgather(
+            np.asarray([my_crc], np.uint32))).reshape(pcount, -1)
+        for p in range(pcount):
+            crcs[_shards_name(gen, p)] = int(all_crcs[p, 0])
     if pidx == 0:
         meta = {
             "treedef": _treedef_to_json(treedef),
@@ -224,9 +295,22 @@ def save(path: str, tree: Any, step: Optional[int] = None,
             fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
             with os.fdopen(fd, "wb") as f:  # savez appends .npz to bare paths
                 np.savez(f, **arrays)
+            meta["crc32"] = dict(crcs,
+                                 **{_data_name(gen): _crc32_file(tmp)})
             fd, tmp_meta = tempfile.mkstemp(dir=path, suffix=".json.tmp")
             with os.fdopen(fd, "w") as f:
                 json.dump(meta, f)
+            if keep >= 2:
+                # the outgoing meta becomes the fallback generation's
+                # meta; written via tmp+rename so a crash leaves either
+                # the old prev or the new one, never a torn file
+                cur = os.path.join(path, _META)
+                if os.path.exists(cur):
+                    fd2, tmp_prev = tempfile.mkstemp(dir=path,
+                                                     suffix=".prev.tmp")
+                    with os.fdopen(fd2, "w") as dst, open(cur) as src:
+                        dst.write(src.read())
+                    os.replace(tmp_prev, os.path.join(path, _PREV_META))
             os.replace(tmp, os.path.join(path, _data_name(gen)))
             os.replace(tmp_meta, os.path.join(path, _META))  # commit point
 
@@ -240,7 +324,24 @@ def save(path: str, tree: Any, step: Optional[int] = None,
         # don't let any process see the checkpoint before meta is visible
         multihost_utils.sync_global_devices("zoo_ckpt_meta_written")
     if pidx == 0:
-        _gc_stale_generations(path, gen)
+        live = {gen}
+        prev_file = os.path.join(path, _PREV_META)
+        if keep >= 2:
+            try:
+                with open(prev_file) as f:
+                    prev_gen = json.load(f).get("gen")
+                if prev_gen:
+                    live.add(prev_gen)
+            except (OSError, json.JSONDecodeError):
+                pass
+        else:
+            # keep=1 after an earlier keep>=2 run: the prev meta would
+            # dangle once its generation's files are collected
+            try:
+                os.remove(prev_file)
+            except OSError:
+                pass
+        _gc_stale_generations(path, live)
     metrics_lib.get_registry().observe(
         "checkpoint.save_ms", (time.monotonic() - t_save) * 1000.0)
     return path
@@ -265,12 +366,15 @@ def _shards_name(gen: Optional[str], proc: int) -> str:
     return (f"shards_{gen}_p{proc}.npz" if gen else f"shards_p{proc}.npz")
 
 
-def _gc_stale_generations(path: str, live_gen: str) -> None:
+def _gc_stale_generations(path: str, live_gens: set) -> None:
     """Remove data files from superseded saves (only after the new meta is
-    visible; a crash mid-GC just leaves unreferenced files)."""
+    visible; a crash mid-GC just leaves unreferenced files).  Files from
+    any generation in ``live_gens`` — the new save plus, with
+    ``keep>=2``, the retained fallback — survive."""
     for name in os.listdir(path):
         if ((name.startswith("arrays_") or name.startswith("shards_"))
-                and name.endswith(".npz") and live_gen not in name):
+                and name.endswith(".npz")
+                and not any(g in name for g in live_gens)):
             try:
                 os.remove(os.path.join(path, name))
             except OSError:
@@ -280,11 +384,15 @@ def _gc_stale_generations(path: str, live_gen: str) -> None:
 class _ShardFiles:
     """Cached reads of shards_<gen>_p<i>.npz (shared filesystem).  npz
     members are decompressed on every [] access, so cache by (proc, key) —
-    replicated leaves would otherwise re-read one member per device."""
+    replicated leaves would otherwise re-read one member per device.
+    Each file's crc32 is verified against the save-time record on first
+    open (CheckpointCorruptError on mismatch)."""
 
-    def __init__(self, path: str, gen: Optional[str]):
+    def __init__(self, path: str, gen: Optional[str],
+                 crcs: Optional[Dict[str, int]] = None):
         self.path = path
         self.gen = gen
+        self._crcs = crcs
         self._open: dict = {}
         self._arrays: dict = {}
 
@@ -292,9 +400,10 @@ class _ShardFiles:
         ck = (proc, key)
         if ck not in self._arrays:
             if proc not in self._open:
+                name = _shards_name(self.gen, proc)
+                _verify_crc(self.path, name, self._crcs)
                 self._open[proc] = np.load(
-                    os.path.join(self.path, _shards_name(self.gen, proc)),
-                    allow_pickle=False)
+                    os.path.join(self.path, name), allow_pickle=False)
             self._arrays[ck] = self._open[proc][key]
         return self._arrays[ck]
 
@@ -388,14 +497,46 @@ def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
     ``mesh``: alternative to ``shardings`` — place each sharded leaf with
     the PartitionSpec recorded at save time, on this mesh.  Leaves whose
     spec doesn't fit the mesh assemble densely instead.
+
+    Integrity: every data file read is verified against the crc32
+    recorded at save time.  A mismatch raises
+    :class:`CheckpointCorruptError` naming the corrupt file — unless the
+    directory still holds the previous complete generation (saved with
+    ``keep=2``), in which case restore falls back to it with a WARNING
+    and the ``checkpoint.corrupt_files`` counter records the event.
     """
     t_restore = time.monotonic()
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
-    npz = np.load(os.path.join(path, _data_name(meta.get("gen"))),
-                  allow_pickle=False)
+    try:
+        out = _restore_from_meta(path, meta, shardings, mesh)
+    except CheckpointCorruptError as e:
+        prev_meta = None
+        try:
+            with open(os.path.join(path, _PREV_META)) as f:
+                prev_meta = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        if prev_meta is None or prev_meta.get("gen") == meta.get("gen"):
+            raise
+        logger.warning(
+            "checkpoint at %s is corrupt (%s); falling back to the "
+            "previous complete generation (gen %s, step %s)", path, e,
+            prev_meta.get("gen"), prev_meta.get("step"))
+        out = _restore_from_meta(path, prev_meta, shardings, mesh)
+    metrics_lib.get_registry().observe(
+        "checkpoint.restore_ms", (time.monotonic() - t_restore) * 1000.0)
+    return out
+
+
+def _restore_from_meta(path: str, meta: dict, shardings: Any,
+                       mesh: Any) -> Any:
+    crcs = meta.get("crc32")
+    data_name = _data_name(meta.get("gen"))
+    _verify_crc(path, data_name, crcs)
+    npz = np.load(os.path.join(path, data_name), allow_pickle=False)
     shard_meta = meta.get("sharded") or [None] * meta["n_leaves"]
-    files = _ShardFiles(path, meta.get("gen"))
+    files = _ShardFiles(path, meta.get("gen"), crcs=crcs)
     shard_list = (jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda x: x is None)
         if shardings is not None else [None] * meta["n_leaves"])
@@ -418,10 +559,7 @@ def restore(path: str, shardings: Any = None, mesh: Any = None) -> Any:
         else:
             leaves.append(_decode_scalar(enc))
     treedef = _treedef_from_json(meta["treedef"])
-    out = jax.tree_util.tree_unflatten(treedef, leaves)
-    metrics_lib.get_registry().observe(
-        "checkpoint.restore_ms", (time.monotonic() - t_restore) * 1000.0)
-    return out
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def load_extra(path: str) -> dict:

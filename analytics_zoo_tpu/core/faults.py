@@ -14,6 +14,8 @@ Registered points (new subsystems add theirs via ``register_point``):
 - ``serving.conn_drop``      server closes a client connection mid-request
 - ``serving.model_latency``  extra latency before a serving batch runs
 - ``serving.queue_reject``   serving queue push rejected ("queue full")
+- ``serving.health_fail``    server swallows a health ping (no pong)
+- ``serving.replica_down``   serving replica dies hard (SIGKILL-equivalent)
 - ``checkpoint.write_fail``  transient checkpoint write failure (OSError)
 - ``feed.stall``             data feed stalls before yielding a batch
 - ``feed.read_fail``         one sample-loader read fails (streaming feed)
@@ -55,6 +57,8 @@ KNOWN_POINTS = {
     "serving.conn_drop",
     "serving.model_latency",
     "serving.queue_reject",
+    "serving.health_fail",
+    "serving.replica_down",
     "checkpoint.write_fail",
     "feed.stall",
     "feed.read_fail",

@@ -11,9 +11,11 @@ Client semantics are preserved: ``InputQueue.enqueue`` → uuid,
 
 from .inference_model import InferenceModel, enable_aot_cache
 from .server import ClusterServing
-from .client import InputQueue, OutputQueue
+from .client import InputQueue, OutputQueue, RetryPolicy
+from .router import CircuitBreaker, ReplicaSet
 from .http_frontend import HTTPFrontend
 
 __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
-           "InputQueue", "OutputQueue",
+           "InputQueue", "OutputQueue", "RetryPolicy",
+           "CircuitBreaker", "ReplicaSet",
            "HTTPFrontend"]

@@ -38,7 +38,16 @@ from . import protocol
 logger = logging.getLogger("analytics_zoo_tpu")
 
 #: Server error replies that mean "try again", not "your request is bad".
-RETRYABLE_ERRORS = ("queue full", "server shutting down")
+#: ``draining`` is the rolling-restart reply: the replica is finishing
+#: in-flight work and a retry (after backoff) lands on this port's
+#: successor — or, behind the router, on a sibling replica immediately.
+RETRYABLE_ERRORS = ("queue full", "server shutting down", "draining")
+
+#: The keys of ``_Conn.stats`` — shared with consumers that must render
+#: a zeroed stats dict for a connection that doesn't exist yet (the
+#: frontend's per-replica ``/stats`` view), so the payload shape cannot
+#: drift when a counter is added here.
+CONN_STATS_KEYS = ("reconnects", "resends", "retries", "replayed")
 
 
 @dataclass
@@ -95,10 +104,15 @@ class _Conn:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  retry: Optional[RetryPolicy] = None,
-                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.host, self.port = host, port
         self.connect_timeout = timeout
         self.retry = retry or RetryPolicy()
+        # extra metric labels on every client.* series this connection
+        # emits — the router labels each replica's connection
+        # ``replica=host:port`` so one scrape separates the backends
+        self._labels = dict(labels or {})
         # insertion-ordered (dicts are), so eviction drops the oldest
         self._results: Dict[str, Tuple[Optional[np.ndarray], Optional[str],
                                        Optional[Dict]]]
@@ -113,9 +127,13 @@ class _Conn:
         self._send_lock = threading.Lock()
         self._conn_lock = threading.Lock()  # serializes reconnects
         self._closed = False
-        self.stats = {"reconnects": 0, "resends": 0, "retries": 0}
+        self.stats = dict.fromkeys(CONN_STATS_KEYS, 0)
+        # uuid -> times its frame was replayed by a reconnect; bounded by
+        # the retry policy so a flapping backend can't replay forever
+        self._replay_counts: Dict[str, int] = {}
         self._metrics = metrics or metrics_lib.get_registry()
-        self._m_request = self._metrics.histogram("client.request_ms")
+        self._m_request = self._metrics.histogram("client.request_ms",
+                                                  **self._labels)
         self.sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._connect()
@@ -124,7 +142,7 @@ class _Conn:
         """One resilience event: the legacy ``stats`` dict AND the
         process registry (``client.<key>``) move together."""
         self.stats[key] += 1
-        self._metrics.inc("client." + key)
+        self._metrics.inc("client." + key, **self._labels)
 
     def trace_id(self, uid: str) -> Optional[str]:
         """The trace id stamped on request ``uid`` (None once the
@@ -159,9 +177,11 @@ class _Conn:
                     return
                 header, arr = protocol.decode(frame)
                 with self._cond:
+                    # the full header, not just stages: pong replies
+                    # carry their payload (state, queue_depth) there
                     self._results[header["uuid"]] = (arr,
                                                      header.get("error"),
-                                                     header.get("stages"))
+                                                     header)
                     while len(self._results) > self.MAX_UNCLAIMED:
                         self._results.pop(next(iter(self._results)))
                     self._cond.notify_all()
@@ -211,14 +231,42 @@ class _Conn:
         old socket too — without a full replay, only the thread that
         noticed the dead reader would retry, and the rest would silently
         wait out their timeouts.  Duplicates are harmless: replies key on
-        uuid and inference is deterministic."""
+        uuid and inference is deterministic.
+
+        Replays per uid are BOUNDED by the retry policy: a backend that
+        flaps faster than it answers would otherwise replay the same
+        frames on every reconnect, forever.  A uid over the cap is failed
+        with a visible error reply (its ``query`` raises instead of
+        waiting out the timeout) and dropped from the record."""
+        cap = self.retry.max_attempts
         with self._cond:
-            frames = list(self._inflight.values())
+            items = list(self._inflight.items())
+            frames = []
+            for uid, frame in items:
+                n = self._replay_counts.get(uid, 0) + 1
+                if n > cap:
+                    self._inflight.pop(uid, None)
+                    self._inflight_bytes -= len(frame)
+                    self._replay_counts.pop(uid, None)
+                    self._results[uid] = (
+                        None,
+                        f"replay budget exhausted: request replayed "
+                        f"{cap} times across reconnects without a reply",
+                        None)
+                    continue
+                self._replay_counts[uid] = n
+                frames.append(frame)
+            if len(frames) < len(items):
+                self._cond.notify_all()
+                logger.warning(
+                    "%d in-flight request(s) exceeded the replay cap "
+                    "(%d) and were failed", len(items) - len(frames), cap)
         for frame in frames:
             try:
                 with self._send_lock:
                     protocol.send_frame(self.sock, frame)
                 self._bump("resends")
+                self._bump("replayed")
             except OSError:
                 return  # died again: the next liveness check handles it
 
@@ -237,6 +285,11 @@ class _Conn:
         frame = protocol.encode(header, arr)
         uid = header["uuid"]
         with self._cond:
+            old = self._inflight.get(uid)
+            if old is not None:
+                # same uid re-sent (router retry on this replica): the
+                # byte accounting must not count the frame twice
+                self._inflight_bytes -= len(old)
             self._inflight[uid] = frame
             self._inflight_bytes += len(frame)
             if header.get("trace") is not None:
@@ -247,6 +300,7 @@ class _Conn:
                 dropped = self._inflight.pop(evicted)
                 self._inflight_bytes -= len(dropped)
                 self._traces.pop(evicted, None)
+                self._replay_counts.pop(evicted, None)
         self._send_frame_with_retry(uid, frame)
 
     def resend(self, uid: str) -> bool:
@@ -295,7 +349,10 @@ class _Conn:
     # -- receiving -------------------------------------------------------------
 
     def wait(self, uid: str, timeout: Optional[float]
-             ) -> Optional[Tuple[Optional[np.ndarray], Optional[str]]]:
+             ) -> Optional[Tuple[Optional[np.ndarray], Optional[str],
+                                 Optional[Dict]]]:
+        """The ``(array, error, reply header)`` triple for ``uid``, or
+        None on timeout."""
         with self._cond:
             ok = self._cond.wait_for(lambda: uid in self._results,
                                      timeout=timeout)
@@ -304,6 +361,25 @@ class _Conn:
             # the resend record stays until the caller accepts the reply
             # (query retries "queue full" replies by resending it)
             return self._results.pop(uid)
+
+    def ping(self, timeout: float = 1.0) -> Optional[Dict]:
+        """One health-probe round trip: the pong header (``state``,
+        ``queue_depth``) or None when no pong arrives in ``timeout``.
+        Deliberately NO retry and NO reconnect — a failed probe IS the
+        signal the health checker exists to observe."""
+        uid = f"ping-{uuid_mod.uuid4().hex[:12]}"
+        try:
+            with self._send_lock:
+                protocol.send_frame(self.sock, protocol.encode_ping(uid))
+        except (OSError, AttributeError):  # dead or never-connected sock
+            return None
+        res = self.wait(uid, timeout)
+        if res is None:
+            return None
+        _, err, header = res
+        if err is not None and not (header or {}).get("pong"):
+            return None  # an error reply that isn't even a pong
+        return header
 
     def peek(self, uid: str):
         with self._cond:
@@ -317,6 +393,7 @@ class _Conn:
             frame = self._inflight.pop(uid, None)
             if frame is not None:
                 self._inflight_bytes -= len(frame)
+            self._replay_counts.pop(uid, None)
             return self._traces.pop(uid, None)
 
 
@@ -326,16 +403,23 @@ class InputQueue:
     def __init__(self, host: str = "127.0.0.1", port: int = 8980,
                  frontend_url: Optional[str] = None,
                  retry: Optional[RetryPolicy] = None,
-                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None,
+                 labels: Optional[Dict[str, str]] = None):
         if frontend_url:  # "host:port" parity with the reference's url conf
             host, port_s = frontend_url.rsplit(":", 1)
             port = int(port_s)
-        self._conn = _Conn(host, port, retry=retry, metrics=metrics)
+        self._conn = _Conn(host, port, retry=retry, metrics=metrics,
+                           labels=labels)
 
     def enqueue(self, name: str, deadline: Optional[float] = None,
-                trace_id: Optional[str] = None,
+                trace_id: Optional[str] = None, uid: Optional[str] = None,
                 **kwargs: np.ndarray) -> str:
         """Send one named tensor; returns the uuid to ``query`` on.
+
+        ``uid``: explicit request uuid (auto-generated when omitted).
+        The router's failover passes the FAILED attempt's uuid when it
+        re-enqueues on a sibling replica, keeping the retry idempotent
+        end to end exactly like a same-connection resend.
 
         ``deadline``: optional per-request budget in SECONDS, carried to
         the server as ``deadline_ms`` in the frame header.  The server
@@ -352,7 +436,7 @@ class InputQueue:
             raise ValueError("exactly one named tensor per enqueue "
                              "(reference: t=ndarray)")
         (_, arr), = kwargs.items()
-        uid = f"{name}-{uuid_mod.uuid4()}"
+        uid = uid or f"{name}-{uuid_mod.uuid4()}"
         header: Dict = {"uuid": uid,
                         "trace": trace_id or trace_lib.new_trace_id()}
         if deadline is not None:
@@ -421,7 +505,8 @@ class OutputQueue:
                         conn.forget(uid)
                         raise
                 continue
-            arr, err, stages = res
+            arr, err, header = res
+            stages = (header or {}).get("stages")
             if err is None:
                 info = conn.forget(uid)
                 if info is not None:

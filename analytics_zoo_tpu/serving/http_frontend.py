@@ -5,17 +5,32 @@ Reference (SURVEY.md §2.8): the akka-http gateway
 into the Redis queue, awaited the result key, and responded.
 
 TPU-native: a stdlib ThreadingHTTPServer that rides the SAME data path as
-binary clients — each request is enqueued over the TCP protocol
-(InputQueue), awaited by uuid (OutputQueue), and returned as JSON.  The
-frontend therefore shares the native queue, the micro-batcher, and the AOT
-executables with every other client instead of owning a second inference
-path.
+binary clients — each request goes through a :class:`ReplicaSet`
+(serving/router.py) over the TCP protocol, awaited by uuid, and returned
+as JSON.  The frontend therefore shares the native queue, the
+micro-batcher, and the AOT executables with every other client instead
+of owning a second inference path.
+
+High availability (ISSUE 5): the frontend is no longer hard-wired to one
+backend.  Pass ``backends=["host:port", ...]`` (or a prebuilt
+``router=ReplicaSet(...)``) and requests are least-pending routed with
+retry-on-other-replica failover, per-replica circuit breakers, active
+health checking and optional hedged reads — a replica dying hard or
+draining for a rolling restart costs latency, not errors.  The
+single-backend constructor shape (``serving_host``/``serving_port``) is
+unchanged and simply builds a one-replica set.
 
 Endpoints (TF-Serving-flavored JSON):
   POST /predict   {"instances": <nested list>, "dtype": "float32"?,
                    "deadline_ms": <int>?}
                   → {"predictions": <nested list>}
-  GET  /health    → {"status": "ok"}
+  GET  /health    → {"status": "ok"}  (the frontend process itself)
+  GET  /healthz   → {"status": "ok"|"degraded"|"down",
+                     "replicas": {"<host:port>": {healthy, state,
+                     breaker, pending, ...}}} — the routed view; HTTP
+                     503 when NO replica is available, 200 otherwise,
+                     so a load balancer can pull a frontend whose whole
+                     backend set is gone
   GET  /stats     → namespaced counters: ``frontend.*`` (this gateway),
                     ``client.*`` (the resilient backend connection),
                     ``server.*`` (the serving pipeline's counters, when
@@ -59,7 +74,7 @@ import numpy as np
 
 from analytics_zoo_tpu.core import metrics as metrics_lib
 from analytics_zoo_tpu.core import trace as trace_lib
-from .client import InputQueue, OutputQueue
+from .router import ReplicaSet
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -75,10 +90,24 @@ class HTTPFrontend:
     def __init__(self, serving_host: str = "127.0.0.1",
                  serving_port: int = 8980, host: str = "127.0.0.1",
                  port: int = 0, query_timeout: float = 30.0,
+                 backends: Optional[list] = None,
+                 router: Optional[ReplicaSet] = None,
+                 hedge_ms: Optional[float] = None,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None):
-        self._serving_addr = (serving_host, serving_port)
+        """``backends``: list of ``"host:port"`` (or ``(host, port)``)
+        serving replicas — the HA deployment shape.  ``router``: a fully
+        configured ReplicaSet to use instead (the frontend owns and
+        closes it either way).  With neither, the single
+        ``serving_host:serving_port`` backend is wrapped in a
+        one-replica set, preserving the original behavior."""
         self._metrics = metrics or metrics_lib.get_registry()
-        self._connect()  # after _metrics: the backend conn reports to it
+        if router is not None:
+            self._router = router
+        else:
+            self._router = ReplicaSet(
+                backends or [(serving_host, serving_port)],
+                query_timeout=query_timeout, hedge_ms=hedge_ms,
+                metrics=self._metrics)
         self.query_timeout = query_timeout
         # handle-per-counter: the old dict + lock, now shared with every
         # other telemetry consumer (snapshot / Prometheus / JSONL)
@@ -117,10 +146,18 @@ class HTTPFrontend:
             def do_GET(self):
                 t0 = time.monotonic()
                 route = self.path if self.path in (
-                    "/", "/health", "/stats", "/metrics") else "other"
+                    "/", "/health", "/healthz", "/stats",
+                    "/metrics") else "other"
                 try:
                     if self.path in ("/", "/health"):
                         self._json(200, {"status": "ok"})
+                    elif self.path == "/healthz":
+                        # own + per-replica health; 503 only when NO
+                        # replica is routable, so load balancers pull a
+                        # frontend whose whole backend set is down
+                        hz = frontend.healthz()
+                        self._json(200 if hz["status"] != "down" else 503,
+                                   hz)
                     elif self.path == "/stats":
                         self._json(200, frontend.stats())
                     elif self.path == "/metrics":
@@ -214,21 +251,45 @@ class HTTPFrontend:
             self._route_hists[route] = h
         h.observe(ms)
 
+    def healthz(self) -> dict:
+        """The ``/healthz`` payload: the router's per-replica view plus
+        this gateway's own liveness (trivially ok if we are answering)."""
+        hz = self._router.healthz()
+        hz["frontend"] = "ok"
+        return hz
+
     def stats(self) -> dict:
         """The ``/stats`` payload: namespaced ``frontend.*`` /
         ``client.*`` counters plus the flat back-compat view (old key
         names, no prefix).  Namespacing fixes the key-collision bug
         where ``dict.update(conn.stats)`` could silently clobber
-        same-named frontend keys."""
+        same-named frontend keys.  With multiple replicas, per-replica
+        ``client.<key>{replica=...}`` entries ride along and the
+        unlabeled keys are the SUM across replicas (what the old
+        single-backend dashboards summed implicitly)."""
         out: dict = {}
         for key, c in self._counters.items():
             out[f"frontend.{key}"] = c.value
-        for key, v in self._in.conn.stats.items():
+        conn_stats = self._conn_stats_by_replica()
+        totals: dict = {}
+        for name, st in conn_stats.items():
+            for key, v in st.items():
+                totals[key] = totals.get(key, 0) + v
+                if len(conn_stats) > 1:
+                    out[f"client.{key}{{replica={name}}}"] = v
+        for key, v in totals.items():
             out[f"client.{key}"] = v
         # registry-only client series (e.g. client.timeouts, which has
         # no conn.stats mirror) complete the namespaced view
         for key, v in self._metrics.flat(prefix="client.").items():
             out.setdefault(f"client.{key}", v)
+        # the router's health/breaker view: one poll answers "which
+        # replica is taking the traffic and which is ejected?"
+        hz = self._router.healthz()
+        out["router.status"] = hz["status"]
+        for name, rep in hz["replicas"].items():
+            if len(hz["replicas"]) > 1:
+                out[f"router.replica{{replica={name}}}"] = rep
         # co-located serving pipeline counters (requests / replies /
         # rejected / shed / drained + the queue-depth gauge): when the
         # backend shares this process registry, one /stats poll answers
@@ -246,33 +307,35 @@ class HTTPFrontend:
         # disjoint today and the namespaced keys above are authoritative
         for key, c in self._counters.items():
             out[key] = c.value
-        out.update(self._in.conn.stats)
+        out.update(totals)
         return out
 
-    def _connect(self) -> None:
-        # the same registry this frontend serves at /metrics: client.*
-        # series from the backend connection must land in one scrape
-        self._in = InputQueue(*self._serving_addr, metrics=self._metrics)
-        self._out = OutputQueue(input_queue=self._in)
+    def _conn_stats_by_replica(self) -> dict:
+        from .client import CONN_STATS_KEYS
+        stats = {}
+        for r in self._router.replicas:
+            stats[r.name] = (dict(r._conn.stats) if r._conn is not None
+                             else dict.fromkeys(CONN_STATS_KEYS, 0))
+        return stats
 
     def predict(self, arr: np.ndarray,
                 deadline: Optional[float] = None,
                 trace_id: Optional[str] = None) -> Optional[np.ndarray]:
-        """One request through the shared connection.  Reconnect-with-
-        backoff, idempotent re-enqueue and retryable-error handling all
-        live in the resilient client underneath (serving/client.py) — a
-        backend restart surfaces here only as a slightly slower reply.
+        """One request through the replica set.  Least-pending routing,
+        retry-on-other-replica failover, circuit breaking, reconnect
+        with backoff and idempotent re-enqueue all live underneath
+        (serving/router.py + serving/client.py) — a backend restart or
+        replica loss surfaces here only as a slightly slower reply.
         ``deadline`` (seconds) rides to the server so an expired request
         is shed instead of served; ``trace_id`` joins the request to an
-        existing end-to-end trace (core/trace.py)."""
-        # wait a grace window past the deadline: the shed happens when the
-        # batcher reaches the request, and its explicit "deadline exceeded"
-        # reply beats an anonymous client-side timeout as the 504 reason
-        timeout = (self.query_timeout if deadline is None
-                   else min(self.query_timeout, deadline + 1.0))
-        uid = self._in.enqueue("http", deadline=deadline,
-                               trace_id=trace_id, t=arr)
-        return self._out.query(uid, timeout=timeout)
+        existing end-to-end trace (core/trace.py), and the trace names
+        the replica that served it."""
+        # the router waits a grace window past the deadline: the shed
+        # happens when the batcher reaches the request, and its explicit
+        # "deadline exceeded" reply beats an anonymous client-side
+        # timeout as the 504 reason
+        return self._router.predict(arr, deadline=deadline,
+                                    trace_id=trace_id)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -286,7 +349,12 @@ class HTTPFrontend:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._in.close()  # the backend socket + its reader thread
+        # the replica set: health checker + every backend connection.
+        # Bounded even with a hedged request in flight — predict()
+        # observes the closed flag on its next poll slice.
+        self._router.close()
+
+    close = stop  # alias: the satellite tests close() a frontend
 
     def __enter__(self):
         return self.start()

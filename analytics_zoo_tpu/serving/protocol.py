@@ -38,6 +38,19 @@ import numpy as np
 #: Module-level so deployments (and tests) can raise/lower it.
 MAX_FRAME_BYTES = 256 * 1024 * 1024
 
+#: Header ``type`` for a health probe.  A ping frame is header-only
+#: (``{"uuid": ..., "type": PING}``, no tensor); the server answers it
+#: from the ASSEMBLY stage with ``{"uuid": ..., "pong": True,
+#: "state": ..., "queue_depth": ...}`` — so a wedged-but-connected
+#: backend (assembly stalled, queue jammed) fails the probe by timeout
+#: even though its socket still accepts writes.
+PING = "ping"
+
+
+def encode_ping(uid: str) -> bytes:
+    """A health-probe frame for ``uid`` (header-only, no tensor)."""
+    return encode({"uuid": uid, "type": PING})
+
 Frame = Union[bytes, bytearray]
 
 

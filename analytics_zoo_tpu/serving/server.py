@@ -29,6 +29,26 @@ stalled all inference):
 
 ``inference_workers=1`` restores the strictly serialized inference
 order of the pre-pipeline server (bisection baseline).
+
+High availability (ISSUE 5): this server is designed to run as one
+replica of N behind ``serving/router.py``:
+
+- **health pings** — a header-only ``{"type": "ping"}`` frame rides the
+  native queue and is answered by the ASSEMBLY stage (the single
+  ordered stage), so a wedged-but-connected replica (assembly stalled
+  on an armed ``serving.model_latency``, queue jammed) fails the probe
+  by timeout even though its socket still accepts writes;
+- **graceful drain** — ``drain()`` flips the server to a ``draining``
+  state: new requests get a retryable ``"draining"`` reply while
+  in-flight batches finish, so a rolling restart sheds zero requests;
+- **admission control** — a request whose whole deadline budget is
+  below the observed queue wait (EWMA) is rejected at arrival
+  (``deadline unattainable``) instead of being shed later, and
+  ``admission_queue_limit`` puts a soft depth cap in front of the
+  native queue's hard one;
+- **hard-kill** — ``kill()`` (and the ``serving.replica_down`` fault
+  point) dies the way SIGKILL would: no drain replies, no flushes —
+  the failure mode the router's failover must absorb.
 """
 
 from __future__ import annotations
@@ -64,12 +84,13 @@ def _config_default(field: str, fallback: Any) -> Any:
 
 class _Pending:
     __slots__ = ("uuid", "arr", "conn", "lock", "writer", "expires",
-                 "trace", "enq_t", "wait_ms")
+                 "trace", "enq_t", "wait_ms", "ping")
 
-    def __init__(self, uid: str, arr: np.ndarray, conn: socket.socket,
+    def __init__(self, uid: str, arr: Optional[np.ndarray],
+                 conn: socket.socket,
                  lock: threading.Lock, writer: "Optional[_ConnWriter]",
                  expires: Optional[float] = None,
-                 trace: Optional[str] = None):
+                 trace: Optional[str] = None, ping: bool = False):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
@@ -83,6 +104,7 @@ class _Pending:
         self.trace = trace
         self.enq_t = time.monotonic()  # arrival → assembly = queue wait
         self.wait_ms = 0.0             # filled at assembly pickup
+        self.ping = ping               # health probe: answered, not batched
 
 
 class _AssembledBatch:
@@ -194,6 +216,7 @@ class ClusterServing:
                  push_timeout: float = 5.0,
                  inference_workers: Optional[int] = None,
                  staging_pool: Optional[int] = None,
+                 admission_queue_limit: Optional[int] = None,
                  faults: Optional[FaultRegistry] = None,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None):
         """``inference_workers``: concurrent model-call threads pulling
@@ -203,7 +226,13 @@ class ClusterServing:
 
         ``staging_pool``: per-shape-bucket staging buffers kept for
         reuse (default ``inference_workers + 2``); beyond the pool,
-        assembly allocates fresh buffers rather than blocking."""
+        assembly allocates fresh buffers rather than blocking.
+
+        ``admission_queue_limit``: soft admission cap — reject new
+        requests with a retryable ``queue full`` reply once the native
+        queue's depth reaches this (default None = only the queue's own
+        hard bound applies).  Set below ``queue_items`` so a router can
+        fail over to an emptier replica before this one saturates."""
         self.model = model
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
@@ -218,6 +247,12 @@ class ClusterServing:
             staging_pool = _config_default("staging_pool", None)
         self.staging_pool = (int(staging_pool) if staging_pool
                              else self.inference_workers + 2)
+        self.admission_queue_limit = admission_queue_limit
+        # EWMA of observed queue waits (ms), written only by the single
+        # assembly thread, read by conn threads for the deadline-aware
+        # admission gate (a request whose whole budget is below the
+        # typical wait would only be shed later — reject it at the door)
+        self._wait_ewma = 0.0
         self._faults = faults or get_registry()
         self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
         # assembled-batch queue: SMALL on purpose — backpressure must
@@ -239,6 +274,7 @@ class ClusterServing:
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._threads: List[threading.Thread] = []
         self._threads_lock = threading.Lock()
         self._conns: set = set()  # open client sockets, for drain/close
@@ -256,7 +292,9 @@ class ClusterServing:
         self._stats_lock = threading.Lock()
         self._counters = {"requests": 0, "replies": 0, "batches": 0,
                           "errors": 0, "batch_rows": 0, "rejected": 0,
-                          "shed": 0, "drained": 0, "shed_batches": 0}
+                          "shed": 0, "drained": 0, "shed_batches": 0,
+                          "pings": 0, "draining_rejected": 0,
+                          "admission_rejected": 0}
         self._metrics = metrics or metrics_lib.get_registry()
         # handle-per-counter (not one-shot inc): _count runs on every
         # request/reply, and a name lookup there would serialize all
@@ -308,7 +346,19 @@ class ClusterServing:
         c["queue_depth"] = self._m_depth.value
         c["queue_depth_max"] = self._m_depth.max
         c["inference_workers"] = self.inference_workers
+        c["state"] = self.state
         return c
+
+    @property
+    def state(self) -> str:
+        """Lifecycle state: ``serving`` → ``draining`` → ``stopped``.
+        Rides every pong so the router (and ``/healthz``) sees a drain
+        begin before the first ``"draining"`` rejection does."""
+        if self._stop.is_set():
+            return "stopped"
+        if self._draining.is_set():
+            return "draining"
+        return "serving"
 
     def _count(self, **deltas: int) -> None:
         with self._stats_lock:
@@ -320,6 +370,12 @@ class ClusterServing:
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "ClusterServing":
+        # idempotent: `ClusterServing(...).start()` used as a context
+        # manager would otherwise double-start the pipeline (a second
+        # assembly thread + worker pool racing the first)
+        with self._threads_lock:
+            if self._threads:
+                return self
         t_accept = threading.Thread(target=self._accept_loop, daemon=True,
                                     name="zoo-serving-accept")
         t_assembly = threading.Thread(target=self._assembly_loop,
@@ -338,6 +394,63 @@ class ClusterServing:
                     self.port, self.batch_size, self.inference_workers,
                     self._queue.is_native)
         return self
+
+    def drain(self, wait: bool = True, timeout: float = 30.0) -> bool:
+        """Enter the ``draining`` state: new requests are rejected with a
+        retryable ``"draining"`` reply (clients back off and land on a
+        sibling replica, or on this port's successor) while everything
+        already admitted finishes normally.  Health pings keep being
+        answered — with ``state="draining"`` — so a router stops routing
+        here *before* the first rejection.
+
+        With ``wait`` (the default), blocks until every admitted request
+        has been answered (``requests == replies + errors`` and no
+        pending entries) or ``timeout`` elapses; returns True iff fully
+        drained.  The rolling-restart recipe is
+        ``srv.drain(); srv.stop()`` — zero dropped requests."""
+        self._draining.set()
+        logger.info("ClusterServing %s:%d draining", self.host, self.port)
+        if not wait:
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                settled = (self._counters["requests"]
+                           == self._counters["replies"]
+                           + self._counters["errors"])
+            with self._pending_lock:
+                settled = settled and not self._pending
+            if settled:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def kill(self) -> None:
+        """Die the way SIGKILL would: close every socket NOW — no drain
+        replies, no writer flushes, pending requests simply vanish.
+        This is the ``serving.replica_down`` failure mode the router's
+        failover (reconnect + idempotent re-enqueue on a sibling
+        replica) must absorb; tests use it to hard-kill an in-process
+        replica without losing the process."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._workers_done.set()
+        self._queue.close()
+        with self._threads_lock:
+            conns = list(self._conns)
+        for s in [self._sock] + conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._m_depth.set(0.0)
+        logger.info("ClusterServing %s:%d hard-killed", self.host,
+                    self.port)
 
     def stop(self, drain_timeout: float = 5.0) -> None:
         """Graceful drain: stop intake, let in-flight pipeline stages
@@ -401,6 +514,13 @@ class ClusterServing:
             except queue_mod.Empty:
                 break
             pending.extend(ab.group)
+        # health probes pending in the queue get a terminal pong (they
+        # never counted as requests, so no error/drained accounting)
+        pings = [p for p in pending if p.ping]
+        pending = [p for p in pending if not p.ping]
+        for p in pings:
+            self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                 "pong": True, "state": "stopped"}, None)
         if pending:
             self._count(errors=len(pending), drained=len(pending))
             for p in pending:
@@ -462,10 +582,30 @@ class ClusterServing:
                     # must recover via reconnect + idempotent re-enqueue
                     logger.debug("fault: dropping connection")
                     return
+                if self._faults.fire("serving.replica_down"):
+                    # injected hard crash: the whole replica vanishes,
+                    # SIGKILL-style — no reply, no drain.  Clients and
+                    # the router recover via reconnect/failover.
+                    logger.debug("fault: replica down")
+                    self.kill()
+                    return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())
                 tid = header.get("trace")
+                if header.get("type") == protocol.PING:
+                    self._enqueue_ping(uid, tid, conn, send_lock, writer)
+                    continue
                 self._count(requests=1)
+                if self._draining.is_set():
+                    # retryable by design: the client backs off and its
+                    # retry lands on a sibling replica (router) or on
+                    # this port's successor (rolling restart)
+                    self._count(errors=1, draining_rejected=1)
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "trace": tid,
+                             "error": "draining"}))
+                    continue
                 if arr is None:
                     # protocol-legal but not servable: a header-only frame
                     # has no tensor to batch — reject here rather than let
@@ -481,6 +621,13 @@ class ClusterServing:
                 deadline_ms = header.get("deadline_ms")
                 expires = (time.monotonic() + deadline_ms / 1000.0
                            if deadline_ms is not None else None)
+                reason = self._admission_reject(deadline_ms)
+                if reason is not None:
+                    self._count(errors=1, admission_rejected=1)
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "trace": tid, "error": reason}))
+                    continue
                 with self._pending_lock:
                     rid = self._next_id
                     self._next_id += 1
@@ -518,6 +665,61 @@ class ClusterServing:
             writer.close()
             conn.close()
 
+    def _admission_reject(self, deadline_ms) -> Optional[str]:
+        """Admission gate, evaluated at arrival: the rejection reason, or
+        None to admit.
+
+        - **queue depth**: past ``admission_queue_limit`` the reply is a
+          retryable ``queue full`` — same semantics as the native
+          queue's hard bound, but tripped early enough that a router can
+          fail over before this replica saturates.
+        - **deadline**: a request whose entire budget is below the
+          observed queue wait (EWMA, maintained by the assembly stage)
+          would be shed after waiting anyway; ``deadline unattainable``
+          at the door costs the client nothing and the queue no slot.
+          Only applies while requests are actually queued (depth >= 1):
+          an idle server's stale EWMA must not reject a fresh burst."""
+        depth = self._m_depth.value
+        if (self.admission_queue_limit is not None
+                and depth >= self.admission_queue_limit):
+            return "queue full (admission limit)"
+        if (deadline_ms is not None and depth >= 1
+                and 0.0 < self._wait_ewma
+                and deadline_ms < self._wait_ewma):
+            return (f"deadline unattainable: budget {deadline_ms}ms < "
+                    f"observed queue wait ~{self._wait_ewma:.0f}ms")
+        return None
+
+    def _enqueue_ping(self, uid: str, tid: Optional[str],
+                      conn: socket.socket, send_lock: threading.Lock,
+                      writer: "Optional[_ConnWriter]") -> None:
+        """Queue a health probe for the ASSEMBLY stage to answer — the
+        point of riding the queue is that a wedged assembly stage (or a
+        jammed queue) fails the probe even though the socket is fine.
+        The push timeout is short: a jammed queue should fail the probe
+        NOW (error-carrying pong), not block this connection's reader
+        for the full ``push_timeout``."""
+        self._count(pings=1)
+        with self._pending_lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._pending[rid] = _Pending(uid, None, conn, send_lock,
+                                          writer, trace=tid, ping=True)
+        self._m_depth.add(1)
+        try:
+            ok = self._queue.push(rid.to_bytes(8, "big"), timeout=0.05)
+        except RuntimeError:  # queue closed: server is stopping
+            self._m_depth.add(-1)
+            raise
+        if not ok:
+            self._m_depth.add(-1)
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            with send_lock:
+                protocol.send_frame(conn, protocol.encode(
+                    {"uuid": uid, "trace": tid, "pong": True,
+                     "state": self.state, "error": "queue full"}))
+
     # -- stage 2: batch assembly ----------------------------------------------
 
     def _assembly_loop(self) -> None:
@@ -551,7 +753,15 @@ class ClusterServing:
             # exactly as the pre-pipeline batcher did, regardless of how
             # many inference workers are idle
             self._faults.fire("serving.model_latency")
-            batch = self._shed_expired([p for p in batch if p is not None])
+            batch = [p for p in batch if p is not None]
+            # health probes are answered HERE — from the single ordered
+            # stage, after any armed latency — so a wedged assembly
+            # stage fails the probe by timeout, exactly like a wedged
+            # model would have under the pre-pipeline batcher
+            for p in batch:
+                if p.ping:
+                    self._answer_ping(p)
+            batch = self._shed_expired([p for p in batch if not p.ping])
             if not batch:
                 continue
             self._assemble_and_dispatch(batch)
@@ -573,6 +783,9 @@ class ClusterServing:
                 buf[i] = p.arr  # row copy into the reused staging buffer
                 p.wait_ms = (now - p.enq_t) * 1000.0
                 self._m_queue_wait.observe(p.wait_ms)
+                # admission-gate estimate: only this (single) assembly
+                # thread writes, conn threads read — GIL-safe
+                self._wait_ewma += 0.2 * (p.wait_ms - self._wait_ewma)
             assembly_ms = (time.monotonic() - t0) * 1000.0
             self._m_assembly.observe(assembly_ms)
             ab = _AssembledBatch(group, buf[:len(group)], buf_key, buf,
@@ -633,6 +846,19 @@ class ClusterServing:
         self._m_depth.add(-1)  # popped from the native queue
         with self._pending_lock:
             return self._pending.pop(rid, None)
+
+    def _answer_ping(self, p: _Pending) -> None:
+        """Pong with the server's state + queue depth — the payload the
+        router's health view is built from.  An armed
+        ``serving.health_fail`` eats the pong (the probe times out
+        client-side): the "wedged backend, healthy socket" failure."""
+        if self._faults.fire("serving.health_fail"):
+            logger.debug("fault: swallowing health ping %s", p.uuid)
+            return
+        self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                             "pong": True, "state": self.state,
+                             "queue_depth": int(self._m_depth.value)},
+                         None)
 
     def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
         """Drop requests whose deadline already passed — running inference
@@ -800,6 +1026,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     finally:
         if frontend is not None:
             frontend.stop()
+        # SIGTERM = rolling-restart contract: drain (retryable
+        # "draining" replies, in-flight batches finish) before stop
+        serving.drain(timeout=10.0)
         serving.stop()
 
 

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""CI guard: the metric catalog in docs/observability.md matches the code.
+
+The catalog drifted risk-free through four PRs — nothing failed when a
+new series was registered but never documented, or a documented series
+was renamed away.  This checker closes the loop without importing (or
+running) anything:
+
+- **code side**: every metric name registered through the
+  ``core/metrics.py`` registry is found by scanning ``analytics_zoo_tpu``
+  sources for ``counter("...")`` / ``gauge("...")`` /
+  ``histogram("...")`` / ``inc("...")`` / ``observe("...")`` /
+  ``set_gauge("...")`` string literals, PLUS the three known dynamic
+  registration sites (``"client." + key`` over the client's stats dict,
+  ``"server." + k`` over the server's counters dict, ``"frontend." +
+  key`` over ``_FRONTEND_COUNTERS``) whose key sets are extracted from
+  the same files;
+- **docs side**: the first column of the catalog table (rows starting
+  with ``| `` + a backtick), splitting ``a / b`` cells.
+
+Exit 1 (with a readable diff) when the code registers a series the
+catalog doesn't document, or the catalog documents a series no code
+registers.  Wired into the test suite
+(``tests/test_observability.py::test_metric_catalog_matches_code``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "analytics_zoo_tpu"
+DOC = REPO / "docs" / "observability.md"
+
+#: registry write/handle calls whose first argument is the series name
+_LITERAL = re.compile(
+    r'\.(?:counter|gauge|histogram|inc|observe|set_gauge)\(\s*'
+    r'"([a-z0-9_.]+)"')
+
+#: dynamic registration sites: (file, metric prefix, regex whose group 1
+#: holds the key set as quoted strings)
+_DYNAMIC = [
+    ("serving/client.py", "client.",
+     re.compile(r"CONN_STATS_KEYS = \(([^)]*)\)", re.S)),
+    ("serving/server.py", "server.",
+     re.compile(r"self\._counters = \{([^}]*)\}", re.S)),
+    ("serving/http_frontend.py", "frontend.",
+     re.compile(r"_FRONTEND_COUNTERS = \(([^)]*)\)", re.S)),
+]
+
+_KEY = re.compile(r'"([a-z0-9_]+)"')
+
+#: catalog table rows: | `name` \| `a` / `b` | type | ...
+_DOC_ROW = re.compile(r"^\|\s*(`[^|]*`)\s*\|", re.M)
+_DOC_NAME = re.compile(r"`([a-z0-9_.]+)`")
+
+
+def code_metrics() -> set:
+    names: set = set()
+    for py in sorted(PKG.rglob("*.py")):
+        text = py.read_text()
+        names.update(_LITERAL.findall(text))
+    for rel, prefix, pattern in _DYNAMIC:
+        text = (PKG / rel).read_text()
+        m = pattern.search(text)
+        if not m:
+            print(f"check_metric_docs: dynamic-site pattern for {rel} "
+                  f"no longer matches — update _DYNAMIC", file=sys.stderr)
+            sys.exit(2)
+        names.update(prefix + k for k in _KEY.findall(m.group(1)))
+    # "client." + key literals are covered by _DYNAMIC; a bare prefix
+    # fragment like "client." itself is not a series
+    return {n for n in names if not n.endswith(".")}
+
+
+def documented_metrics() -> set:
+    names: set = set()
+    for cell in _DOC_ROW.findall(DOC.read_text()):
+        names.update(_DOC_NAME.findall(cell))
+    return names
+
+
+def main() -> int:
+    code = code_metrics()
+    docs = documented_metrics()
+    undocumented = sorted(code - docs)
+    stale = sorted(docs - code)
+    if undocumented:
+        print("metrics registered in code but MISSING from the "
+              "docs/observability.md catalog:")
+        for n in undocumented:
+            print(f"  - {n}")
+    if stale:
+        print("metrics documented in docs/observability.md but no longer "
+              "registered anywhere in analytics_zoo_tpu/:")
+        for n in stale:
+            print(f"  - {n}")
+    if undocumented or stale:
+        return 1
+    print(f"metric catalog in sync: {len(code)} series")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -559,3 +559,17 @@ def test_step_loop_instrumentation_overhead_under_5_percent():
     # 5% relative plus a 5 ms absolute floor: at 128 steps/epoch the
     # telemetry budget is ~40 µs/step, two orders above its real cost
     assert t_on <= t_off * 1.05 + 0.005, (t_on, t_off)
+
+
+def test_metric_catalog_matches_code():
+    """The docs/observability.md catalog must track the code: a series
+    registered but undocumented (or documented but gone) fails here —
+    the catalog drifted risk-free for four PRs before this guard."""
+    import pathlib
+    import subprocess
+    import sys
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "dev" / "check_metric_docs.py")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

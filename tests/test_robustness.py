@@ -471,3 +471,61 @@ def test_http_deadline_propagates_and_stats_surface_counters():
             for key in ("reconnects", "resends", "retries"):
                 assert key in stats
         assert srv.stats()["shed"] == 1
+
+
+# -- checkpoint integrity (crc32, ISSUE 5 satellite) ---------------------------
+
+def _corrupt_file(path):
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+
+
+def test_checkpoint_save_records_crc_and_restore_verifies(tmp_path):
+    import json
+    import os
+    d = str(tmp_path / "ckpt")
+    ckpt_io.save(d, {"w": np.arange(6, dtype=np.float32)}, step=1)
+    meta = json.load(open(os.path.join(d, "treedef.json")))
+    assert meta["crc32"], "save must record per-file crc32s"
+    data_name = next(iter(meta["crc32"]))
+    _corrupt_file(os.path.join(d, data_name))
+    with pytest.raises(ckpt_io.CheckpointCorruptError) as ei:
+        ckpt_io.restore(d)
+    # the error NAMES the corrupt file, and the counter recorded it
+    assert data_name in str(ei.value)
+    from analytics_zoo_tpu.core import metrics
+    snap = metrics.get_registry().snapshot()
+    assert snap["checkpoint.corrupt_files"] >= 1
+
+
+def test_checkpoint_corrupt_latest_falls_back_to_previous_generation(
+        tmp_path, caplog):
+    import json
+    import logging
+    import os
+    d = str(tmp_path / "ckpt")
+    ckpt_io.save(d, {"w": np.zeros(3, np.float32)}, step=1, keep=2)
+    ckpt_io.save(d, {"w": np.ones(3, np.float32)}, step=2, keep=2)
+    assert os.path.exists(os.path.join(d, "treedef.prev.json"))
+    latest_gen = json.load(open(os.path.join(d, "treedef.json")))["gen"]
+    bad = [n for n in os.listdir(d)
+           if n.endswith(".npz") and latest_gen in n][0]
+    _corrupt_file(os.path.join(d, bad))
+    with caplog.at_level(logging.WARNING, logger="analytics_zoo_tpu"):
+        back = ckpt_io.restore(d)
+    np.testing.assert_allclose(back["w"], np.zeros(3))  # previous gen
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_checkpoint_corrupt_without_fallback_raises(tmp_path):
+    import json
+    import os
+    d = str(tmp_path / "ckpt")
+    ckpt_io.save(d, {"w": np.ones(3, np.float32)}, step=1)  # keep=1
+    gen = json.load(open(os.path.join(d, "treedef.json")))["gen"]
+    bad = [n for n in os.listdir(d)
+           if n.endswith(".npz") and gen in n][0]
+    _corrupt_file(os.path.join(d, bad))
+    with pytest.raises(ckpt_io.CheckpointCorruptError, match=bad):
+        ckpt_io.restore(d)
