@@ -115,6 +115,15 @@ class ZooConfig:
     # per-shape-bucket staging buffers kept for reuse by batch assembly
     # (None = inference_workers + 2)
     staging_pool: Optional[int] = None
+    # assembly batching policy (serving/scheduler.py): "window" = fixed
+    # batch window (the bisection baseline) | "continuous" = admit
+    # arrived requests into the very next device step (no window tail,
+    # weighted-fair across models)
+    scheduler: str = "window"
+    # multi-model serving (serving/model_registry.py): {name: saved-model
+    # dir}, loaded by the zoo-serving launcher (--config) into a
+    # ModelRegistry; in code, pass ClusterServing(models=...) directly
+    models: Optional[Dict[str, str]] = None
 
     # logging / summaries (reference: set_tensorboard, TrainSummary)
     log_dir: str = "/tmp/analytics_zoo_tpu"

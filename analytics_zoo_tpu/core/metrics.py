@@ -278,6 +278,20 @@ class MetricsRegistry:
                   **labels: Any) -> Histogram:
         return self._get(Histogram, name, labels, buckets=buckets)
 
+    def remove(self, name: str, **labels: Any) -> None:
+        """Retire one ``(name, labels)`` series — for label values with
+        bounded lifetimes (e.g. a served model VERSION that was
+        unloaded): without retirement every value ever seen stays in
+        every future scrape, and monotone values (v1, v2, ...) grow the
+        registry without bound.  Outstanding handles to the removed
+        series keep working but no longer export.  The name's type
+        registration is dropped with its last series."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._metrics.pop(key, None)
+            if not any(k[0] == name for k in self._metrics):
+                self._types.pop(name, None)
+
     # -- one-shot writes ------------------------------------------------------
 
     def inc(self, name: str, value: float = 1, **labels: Any) -> None:

@@ -10,6 +10,8 @@ Client semantics are preserved: ``InputQueue.enqueue`` → uuid,
 """
 
 from .inference_model import InferenceModel, enable_aot_cache
+from .model_registry import ModelRegistry
+from .scheduler import ContinuousScheduler, Scheduler, WindowScheduler
 from .server import ClusterServing
 from .client import InputQueue, OutputQueue, RetryPolicy
 from .router import CircuitBreaker, ReplicaSet
@@ -18,4 +20,5 @@ from .http_frontend import HTTPFrontend
 __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
            "InputQueue", "OutputQueue", "RetryPolicy",
            "CircuitBreaker", "ReplicaSet",
-           "HTTPFrontend"]
+           "HTTPFrontend", "ModelRegistry",
+           "Scheduler", "WindowScheduler", "ContinuousScheduler"]

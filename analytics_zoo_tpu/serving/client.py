@@ -413,6 +413,8 @@ class InputQueue:
 
     def enqueue(self, name: str, deadline: Optional[float] = None,
                 trace_id: Optional[str] = None, uid: Optional[str] = None,
+                model: Optional[str] = None,
+                version: Optional[str] = None,
                 **kwargs: np.ndarray) -> str:
         """Send one named tensor; returns the uuid to ``query`` on.
 
@@ -431,16 +433,24 @@ class InputQueue:
         ``trace_id``: the end-to-end trace id for this request
         (core/trace.py); auto-generated when omitted, pass one to join
         an existing trace (the HTTP frontend propagates the caller's
-        ``X-Trace-Id`` this way).  Read it back with ``trace_id(uid)``."""
+        ``X-Trace-Id`` this way).  Read it back with ``trace_id(uid)``.
+
+        ``model``/``version``: route to a named model (and optionally a
+        pinned loaded version) in a multi-model server
+        (``ClusterServing(models=...)``, serving/model_registry.py);
+        omitted = the server's default model's active version.  An
+        unroutable pair gets a non-retryable error reply (``query``
+        raises)."""
         if len(kwargs) != 1:
             raise ValueError("exactly one named tensor per enqueue "
                              "(reference: t=ndarray)")
         (_, arr), = kwargs.items()
         uid = uid or f"{name}-{uuid_mod.uuid4()}"
-        header: Dict = {"uuid": uid,
-                        "trace": trace_id or trace_lib.new_trace_id()}
-        if deadline is not None:
-            header["deadline_ms"] = max(1, int(deadline * 1000))
+        header = protocol.request_header(
+            uid, trace=trace_id or trace_lib.new_trace_id(),
+            model=model, version=version,
+            deadline_ms=(max(1, int(deadline * 1000))
+                         if deadline is not None else None))
         self._conn.send_request(header, np.asarray(arr))
         return uid
 

@@ -22,8 +22,12 @@ unchanged and simply builds a one-replica set.
 
 Endpoints (TF-Serving-flavored JSON):
   POST /predict   {"instances": <nested list>, "dtype": "float32"?,
-                   "deadline_ms": <int>?}
+                   "deadline_ms": <int>?, "model": <name>?,
+                   "version": <version>?}
                   → {"predictions": <nested list>}
+                  ``model``/``version`` route within a multi-model
+                  backend (serving/model_registry.py): an unroutable
+                  pair answers 404.
   GET  /health    → {"status": "ok"}  (the frontend process itself)
   GET  /healthz   → {"status": "ok"|"degraded"|"down",
                      "replicas": {"<host:port>": {healthy, state,
@@ -123,9 +127,23 @@ class HTTPFrontend:
             def log_message(self, fmt, *args):  # route to our logger
                 logger.debug("http: " + fmt, *args)
 
+            def _observe_once(self) -> None:
+                # route latency lands BEFORE the response bytes (the
+                # same counters-before-reply rule the serving server
+                # follows): a client that reacts to the reply with an
+                # immediate /metrics scrape must see this request in
+                # the histogram.  Idempotent — the handler's finally
+                # re-calls it to catch replies that failed mid-send.
+                if not getattr(self, "_routed", True):
+                    self._routed = True
+                    frontend._observe_route(
+                        self._route,
+                        (time.monotonic() - self._t0) * 1000.0)
+
             def _json(self, code: int, payload,
                       trace_id: Optional[str] = None) -> None:
                 body = json.dumps(payload).encode()
+                self._observe_once()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -137,6 +155,7 @@ class HTTPFrontend:
             def _text(self, code: int, body: str, content_type: str
                       ) -> None:
                 raw = body.encode()
+                self._observe_once()
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(raw)))
@@ -144,10 +163,11 @@ class HTTPFrontend:
                 self.wfile.write(raw)
 
             def do_GET(self):
-                t0 = time.monotonic()
-                route = self.path if self.path in (
+                self._t0 = time.monotonic()
+                self._route = self.path if self.path in (
                     "/", "/health", "/healthz", "/stats",
                     "/metrics") else "other"
+                self._routed = False
                 try:
                     if self.path in ("/", "/health"):
                         self._json(200, {"status": "ok"})
@@ -171,18 +191,17 @@ class HTTPFrontend:
                         self._json(404,
                                    {"error": f"no route {self.path}"})
                 finally:
-                    frontend._observe_route(
-                        route, (time.monotonic() - t0) * 1000.0)
+                    self._observe_once()
 
             def do_POST(self):
-                t0 = time.monotonic()
-                route = ("/predict" if self.path == "/predict"
-                         else "other")  # don't pollute /predict latency
+                self._t0 = time.monotonic()
+                self._route = ("/predict" if self.path == "/predict"
+                               else "other")  # keep /predict latency pure
+                self._routed = False
                 try:
                     self._do_predict()
                 finally:
-                    frontend._observe_route(
-                        route, (time.monotonic() - t0) * 1000.0)
+                    self._observe_once()
 
             def _do_predict(self):
                 if self.path != "/predict":
@@ -204,6 +223,11 @@ class HTTPFrontend:
                                           self.headers.get("X-Deadline-Ms"))
                     deadline = (float(deadline_ms) / 1000.0
                                 if deadline_ms is not None else None)
+                    # multi-model routing (TF-Serving flavor): name the
+                    # model (and optionally pin a loaded version) in the
+                    # request body; absent = the backend's default model
+                    model = req.get("model")
+                    version = req.get("version")
                 except (KeyError, ValueError, TypeError) as e:
                     frontend._bump("errors")
                     self._json(400, {"error": f"bad request: {e}"},
@@ -211,8 +235,15 @@ class HTTPFrontend:
                     return
                 try:
                     out = frontend.predict(arr, deadline=deadline,
-                                           trace_id=tid)
+                                           trace_id=tid, model=model,
+                                           version=version)
                 except RuntimeError as e:  # serving-side error reply
+                    if ("unknown model" in str(e)
+                            or "unknown version" in str(e)
+                            or "no model specified" in str(e)):
+                        frontend._bump("errors")
+                        self._json(404, {"error": str(e)}, trace_id=tid)
+                        return
                     if "deadline exceeded" in str(e):
                         frontend._bump("deadline_exceeded")
                         self._json(504, {"error": str(e)}, trace_id=tid)
@@ -320,7 +351,9 @@ class HTTPFrontend:
 
     def predict(self, arr: np.ndarray,
                 deadline: Optional[float] = None,
-                trace_id: Optional[str] = None) -> Optional[np.ndarray]:
+                trace_id: Optional[str] = None,
+                model: Optional[str] = None,
+                version: Optional[str] = None) -> Optional[np.ndarray]:
         """One request through the replica set.  Least-pending routing,
         retry-on-other-replica failover, circuit breaking, reconnect
         with backoff and idempotent re-enqueue all live underneath
@@ -335,7 +368,8 @@ class HTTPFrontend:
         # "deadline exceeded" reply beats an anonymous client-side
         # timeout as the 504 reason
         return self._router.predict(arr, deadline=deadline,
-                                    trace_id=trace_id)
+                                    trace_id=trace_id, model=model,
+                                    version=version)
 
     # -- lifecycle ------------------------------------------------------------
 

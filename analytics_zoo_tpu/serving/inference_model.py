@@ -118,6 +118,11 @@ class InferenceModel:
         self._compiled: Dict[Tuple[Any, ...], Any] = {}
         self._sema = threading.Semaphore(concurrent_num)
         self._lock = threading.Lock()
+        # fresh XLA compiles performed by THIS instance (artifact loads
+        # via load_executables and persistent-cache hits do not count):
+        # the serving hot-swap acceptance asserts this stays flat after
+        # warm() — no request ever waits on a cold compile
+        self.compile_count = 0
 
     # -- loaders (reference: doLoadBigDL/doLoadTF/doLoadOpenVINO...) ----------
 
@@ -207,7 +212,59 @@ class InferenceModel:
                                  jax.ShapeDtypeStruct(shape, dtype))
                           .compile())
                     self._compiled[key] = fn
+                    self.compile_count += 1
         return fn
+
+    # -- warmup (the hot-swap seam: compile BEFORE traffic arrives) ----------
+
+    def warm(self, shapes: Sequence[Tuple[int, ...]],
+             dtype: Any = np.float32,
+             buckets: Optional[Sequence[int]] = None) -> int:
+        """AOT-precompile the serving executables for each per-ROW
+        shape × batch bucket, so no request ever waits on a fresh XLA
+        compile — call at startup (before opening the port) and before
+        hot-swapping a model version into service.  ``shapes`` are
+        per-row shapes (no batch dim); ``buckets`` defaults to every
+        ``batch_buckets`` entry.  Returns the number of (shape, bucket)
+        executables now resident."""
+        use = self.batch_buckets if buckets is None else sorted(
+            int(b) for b in buckets)
+        n = 0
+        for shape in shapes:
+            for b in use:
+                self._fn_for((int(b),) + tuple(int(s) for s in shape),
+                             np.dtype(dtype))
+                n += 1
+        return n
+
+    def warm_from(self, other: "InferenceModel") -> int:
+        """Warm this model for the traffic ``other`` has realized — the
+        version hot-swap path: the incoming version warms against the
+        outgoing version's compiled (shape, dtype) set before the
+        registry flips, so the swap costs zero cold compiles.
+
+        The old keys' batch dims are the OUTGOING model's buckets;
+        copying them verbatim would warm shapes this model never pads
+        to when the two versions' ``batch_buckets`` differ.  Each old
+        key is re-bucketed here: its realized row counts were anywhere
+        in (0, old_bucket], so every one of OUR buckets such a count
+        could pad to gets warmed.  Returns the number of executables
+        warmed."""
+        n = 0
+        seen = set()
+        for (shape, dtype_str) in list(getattr(other, "_compiled", {})):
+            row = tuple(shape[1:])
+            cap = self._bucket(int(shape[0]))
+            for b in self.batch_buckets:
+                if b > cap:
+                    break
+                key = ((b,) + row, dtype_str)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self._fn_for((b,) + row, np.dtype(dtype_str))
+                n += 1
+        return n
 
     # -- AOT executable serialization (reference: OpenVINO IR — a compiled
     # artifact loadable without re-running the model optimizer) -------------

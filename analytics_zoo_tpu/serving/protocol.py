@@ -51,6 +51,35 @@ def encode_ping(uid: str) -> bytes:
     """A health-probe frame for ``uid`` (header-only, no tensor)."""
     return encode({"uuid": uid, "type": PING})
 
+
+def request_header(uid: str, trace: Optional[str] = None,
+                   model: Optional[str] = None,
+                   version: Optional[str] = None,
+                   deadline_ms: Optional[int] = None) -> Dict[str, Any]:
+    """The standard request header.  All fields beyond ``uuid`` are
+    OPTIONAL and absent fields are simply omitted from the wire, so a
+    pre-multi-model client's frames are unchanged byte for byte:
+
+    - ``trace``: end-to-end trace id (core/trace.py);
+    - ``model``: route to this named model in a multi-model server
+      (``ClusterServing(models=...)``); absent = the server's default
+      model;
+    - ``version``: pin a specific loaded version of that model (canary
+      reads across a hot swap); absent = the model's ACTIVE version at
+      batch-assembly time;
+    - ``deadline_ms``: relative latency budget, re-anchored server-side.
+    """
+    header: Dict[str, Any] = {"uuid": uid}
+    if trace is not None:
+        header["trace"] = trace
+    if model is not None:
+        header["model"] = str(model)
+    if version is not None:
+        header["version"] = str(version)
+    if deadline_ms is not None:
+        header["deadline_ms"] = int(deadline_ms)
+    return header
+
 Frame = Union[bytes, bytearray]
 
 

@@ -201,13 +201,18 @@ class Replica:
         return self.state
 
     def enqueue(self, uid: str, arr: np.ndarray,
-                deadline: Optional[float], trace_id: str) -> None:
+                deadline: Optional[float], trace_id: str,
+                model: Optional[str] = None,
+                version: Optional[str] = None) -> None:
         """Send one request under an EXPLICIT uuid (failover and hedging
         re-enqueue the same uuid on another replica — the idempotency
-        contract from PR 1, stretched across backends)."""
-        header: Dict[str, Any] = {"uuid": uid, "trace": trace_id}
-        if deadline is not None:
-            header["deadline_ms"] = max(1, int(deadline * 1000))
+        contract from PR 1, stretched across backends).  ``model`` /
+        ``version`` route within a multi-model backend, exactly like
+        ``InputQueue.enqueue``."""
+        header = protocol.request_header(
+            uid, trace=trace_id, model=model, version=version,
+            deadline_ms=(max(1, int(deadline * 1000))
+                         if deadline is not None else None))
         self.conn.send_request(header, np.asarray(arr))
 
     def forget(self, uid: str) -> None:
@@ -357,14 +362,18 @@ class ReplicaSet:
 
     def predict(self, arr: np.ndarray, deadline: Optional[float] = None,
                 trace_id: Optional[str] = None,
-                timeout: Optional[float] = None) -> Optional[np.ndarray]:
+                timeout: Optional[float] = None,
+                model: Optional[str] = None,
+                version: Optional[str] = None) -> Optional[np.ndarray]:
         """One request through the replica set; failover, circuit
         breaking and (optional) hedging happen underneath.
 
         ``deadline``: per-request budget in seconds, propagated to the
         serving frame header exactly like ``InputQueue.enqueue``.
         ``timeout``: overall client-side wait (default ``query_timeout``,
-        bounded near the deadline the way the frontend bounds it)."""
+        bounded near the deadline the way the frontend bounds it).
+        ``model``/``version``: multi-model routing, propagated verbatim
+        to every attempt (failover and hedge included)."""
         if timeout is None:
             timeout = (self.query_timeout if deadline is None
                        else min(self.query_timeout, deadline + 1.0))
@@ -398,14 +407,16 @@ class ReplicaSet:
                     with self._lock:
                         r.pending += 1
                     touched.append(r)
-                    r.enqueue(uid, arr, deadline, tid)
+                    r.enqueue(uid, arr, deadline, tid, model=model,
+                              version=version)
                 except OSError:
                     r.breaker.record_failure()
                     tried.add(r.name)
                     continue
                 kind, payload, rep = self._await(r, uid, arr, until,
                                                  deadline, tid, tried,
-                                                 touched)
+                                                 touched, model=model,
+                                                 version=version)
                 if kind == "ok":
                     out, header = payload
                     rep.breaker.record_success()
@@ -471,7 +482,8 @@ class ReplicaSet:
 
     def _await(self, r: Replica, uid: str, arr: np.ndarray, until: float,
                deadline: Optional[float], tid: str, tried: Set[str],
-               touched: List[Replica]
+               touched: List[Replica], model: Optional[str] = None,
+               version: Optional[str] = None
                ) -> Tuple[str, Any, Optional[Replica]]:
         """Wait for ``uid``'s reply on ``r`` (and on a hedge replica,
         once launched).  Returns ``(kind, payload, replica)`` where kind
@@ -523,7 +535,8 @@ class ReplicaSet:
                         h.pending += 1
                     touched.append(h)  # caller cleans up forget/pending
                     try:
-                        h.enqueue(uid, arr, deadline, tid)
+                        h.enqueue(uid, arr, deadline, tid, model=model,
+                                  version=version)
                         waiting.append(h)
                         self._m_hedges.inc()
                         logger.debug("hedged %s onto %s", uid, h.name)

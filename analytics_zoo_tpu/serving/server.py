@@ -13,11 +13,16 @@ stalled all inference):
   1. a TCP acceptor thread per connection parses frames and pushes
      requests onto a NATIVE C++ bounded queue (the Redis-list
      equivalent);
-  2. an ASSEMBLY thread pops up to ``batch_size`` requests (or
-     ``batch_timeout_ms``), sheds expired deadlines, groups by input
-     shape, and writes each group's rows into a REUSED per-shape
-     staging buffer (no fresh ``np.stack`` allocation per batch),
-     pushing assembled batches onto a small internal queue;
+  2. an ASSEMBLY thread runs a pluggable :class:`Scheduler`
+     (serving/scheduler.py; ISSUE 6) that decides WHEN arrived
+     requests become device batches — ``"window"`` (default, the
+     original fixed batch window: up to ``batch_size`` requests or
+     ``batch_timeout_ms``) or ``"continuous"`` (admit everything
+     arrived into the very next device step, weighted-fair across
+     models) — then sheds expired deadlines, groups by (model,
+     version, input shape), and writes each group's rows into a REUSED
+     per-shape staging buffer (no fresh ``np.stack`` allocation per
+     batch), pushing assembled batches onto a small internal queue;
   3. ``inference_workers`` threads (default 2, bounded by
      ``InferenceModel.concurrent_num``) pull assembled batches and run
      the AOT-compiled model — batch k+1 assembles while batch k
@@ -59,7 +64,7 @@ import socket
 import threading
 import time
 import uuid as uuid_mod
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -68,7 +73,9 @@ from analytics_zoo_tpu.core import trace as trace_lib
 from analytics_zoo_tpu.core.faults import FaultRegistry, get_registry
 from analytics_zoo_tpu.native import NativeQueue
 from .inference_model import InferenceModel
+from .model_registry import ModelRegistry
 from . import protocol
+from . import scheduler as scheduler_lib
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -84,13 +91,15 @@ def _config_default(field: str, fallback: Any) -> Any:
 
 class _Pending:
     __slots__ = ("uuid", "arr", "conn", "lock", "writer", "expires",
-                 "trace", "enq_t", "wait_ms", "ping")
+                 "trace", "enq_t", "wait_ms", "ping", "model", "version")
 
     def __init__(self, uid: str, arr: Optional[np.ndarray],
                  conn: socket.socket,
                  lock: threading.Lock, writer: "Optional[_ConnWriter]",
                  expires: Optional[float] = None,
-                 trace: Optional[str] = None, ping: bool = False):
+                 trace: Optional[str] = None, ping: bool = False,
+                 model: Optional[str] = None,
+                 version: Optional[str] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
@@ -105,22 +114,36 @@ class _Pending:
         self.enq_t = time.monotonic()  # arrival → assembly = queue wait
         self.wait_ms = 0.0             # filled at assembly pickup
         self.ping = ping               # health probe: answered, not batched
+        # routing: the REQUEST's model/version header fields, raw (None
+        # = route to the server's default model).  Resolution against
+        # the registry happens at assembly, so a version hot-swapped
+        # while the request was queued serves the NEW active version.
+        self.model = model
+        self.version = version
 
 
 class _AssembledBatch:
-    """One shape-grouped batch staged for inference: the pending
-    requests, the staged input (a view into a pooled buffer), and the
-    pool key/buffer to release once inference materialized its output."""
+    """One (model, shape)-grouped batch staged for inference: the
+    pending requests, the staged input (a view into a pooled buffer),
+    the pool key/buffer to release once inference materialized its
+    output, and the RESOLVED model the workers must run it on (resolved
+    at assembly so it pins the version active at dispatch time)."""
 
-    __slots__ = ("group", "x", "buf_key", "buf", "assembly_ms")
+    __slots__ = ("group", "x", "buf_key", "buf", "assembly_ms",
+                 "im", "model", "version", "_done")
 
     def __init__(self, group: List[_Pending], x: np.ndarray,
-                 buf_key: Tuple, buf: np.ndarray, assembly_ms: float):
+                 buf_key: Tuple, buf: np.ndarray, assembly_ms: float,
+                 im: Any, model: str, version: str):
         self.group = group
         self.x = x
         self.buf_key = buf_key
         self.buf = buf
         self.assembly_ms = assembly_ms
+        self.im = im          # the resolved model object for this batch
+        self.model = model    # registry name (default traffic resolves)
+        self.version = version
+        self._done = False    # registry in-flight accounting closed?
 
 
 class _ConnWriter:
@@ -210,13 +233,18 @@ class ClusterServing:
     """config parity with the reference's config.yaml: model + batch size +
     address (the Redis url's slot)."""
 
-    def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
+    def __init__(self, model: Optional[InferenceModel] = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, batch_size: int = 16,
                  batch_timeout_ms: int = 5, queue_items: int = 4096,
                  push_timeout: float = 5.0,
                  inference_workers: Optional[int] = None,
                  staging_pool: Optional[int] = None,
                  admission_queue_limit: Optional[int] = None,
+                 scheduler: Union[str, scheduler_lib.Scheduler,
+                                  None] = None,
+                 models: Union[ModelRegistry, Dict[str, Any],
+                               None] = None,
                  faults: Optional[FaultRegistry] = None,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None):
         """``inference_workers``: concurrent model-call threads pulling
@@ -232,14 +260,42 @@ class ClusterServing:
         requests with a retryable ``queue full`` reply once the native
         queue's depth reaches this (default None = only the queue's own
         hard bound applies).  Set below ``queue_items`` so a router can
-        fail over to an emptier replica before this one saturates."""
-        self.model = model
+        fail over to an emptier replica before this one saturates.
+
+        ``scheduler``: assembly batching policy — ``"window"`` (fixed
+        batch window, the bisection baseline), ``"continuous"``
+        (admit arrivals into the very next device step), or a prebuilt
+        :class:`~.scheduler.Scheduler` instance (one per server).
+        Default: ``ZooConfig.scheduler`` (``"window"``).
+
+        ``models``: multi-model serving — a prebuilt
+        :class:`~.model_registry.ModelRegistry` or a ``{name: model}``
+        dict.  Requests route by their ``model`` header field (and an
+        optional ``version`` pin); ``model`` (the positional arg) is
+        additionally registered under the name ``"default"`` and serves
+        requests that name no model."""
+        self._metrics = metrics or metrics_lib.get_registry()
+        self.registry = ModelRegistry.ensure(models,
+                                             metrics=self._metrics)
+        if model is not None:
+            self.registry.register(ModelRegistry.DEFAULT, model)
+        names = self.registry.names()
+        if not names:
+            raise ValueError("ClusterServing needs model= or models=")
+        # where header-less requests route: the "default" entry, or the
+        # single hosted model; None (multi-model, no default) rejects
+        # requests that name no model
+        self._default_name = (
+            ModelRegistry.DEFAULT if ModelRegistry.DEFAULT in names
+            else names[0] if len(names) == 1 else None)
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
         self.push_timeout = push_timeout  # how long accept blocks when full
         if inference_workers is None:
             inference_workers = _config_default("inference_workers", 2)
-        bound = getattr(model, "concurrent_num", None)
+        bounds = [getattr(m, "concurrent_num", None)
+                  for m in self.registry.models()]
+        bound = min([int(b) for b in bounds if b], default=None)
         self.inference_workers = max(1, min(
             int(inference_workers),
             int(bound) if bound else int(inference_workers)))
@@ -294,8 +350,7 @@ class ClusterServing:
                           "errors": 0, "batch_rows": 0, "rejected": 0,
                           "shed": 0, "drained": 0, "shed_batches": 0,
                           "pings": 0, "draining_rejected": 0,
-                          "admission_rejected": 0}
-        self._metrics = metrics or metrics_lib.get_registry()
+                          "admission_rejected": 0, "unknown_model": 0}
         # handle-per-counter (not one-shot inc): _count runs on every
         # request/reply, and a name lookup there would serialize all
         # serving threads on the registry's global lock
@@ -310,15 +365,79 @@ class ClusterServing:
         self._m_reply = self._metrics.histogram("server.reply_ms")
         self._m_shed_per_batch = self._metrics.histogram(
             "server.shed_per_batch", buckets=metrics_lib.SIZE_BUCKETS)
+        # per-(model, version) labeled metric handles, created lazily at
+        # first batch and cached — per-batch registry name lookups would
+        # serialize the inference workers on the registry's global lock.
+        # Retired when the version is unloaded: refresh-style swaps mint
+        # monotone version strings, so without retirement a server
+        # hot-refreshed for months accumulates a dead labeled series
+        # (and a cache entry) per swap in every /metrics scrape.
+        self._m_model_series: Dict[Tuple[str, str], Tuple] = {}
+        if scheduler is None:
+            scheduler = _config_default("scheduler", "window")
+        try:
+            self.scheduler = scheduler_lib.make(scheduler)
+            self.scheduler.attach(self)
+        except Exception:
+            # scheduler validation is the only failure path left after
+            # the socket went listening: close it, or a corrected retry
+            # on the same fixed port hits EADDRINUSE until process exit
+            self._sock.close()
+            raise
+        self.registry.on_unload(self._retire_model_series)
 
-    def update_model(self, model: InferenceModel) -> None:
-        """Hot-swap the serving model without dropping connections
-        (reference: cluster serving's model-update flow — a new model
-        version replaced the loaded one between batches).  In-flight
-        batches finish on the old model; the next batch uses the new one
-        (a single reference assignment, atomic under the GIL)."""
-        self.model = model
-        logger.info("ClusterServing model updated")
+    @property
+    def model(self) -> Any:
+        """The default model's ACTIVE version — the back-compat
+        single-model accessor; the authoritative map is
+        ``self.registry``.  Assigning it is the legacy raw swap (flip
+        with no warming, no drain); prefer :meth:`update_model`."""
+        if self._default_name is None:
+            raise AttributeError(
+                "multi-model server has no single .model; use "
+                "registry.resolve(name)")
+        im, _, _ = self.registry.resolve(self._default_name)
+        return im
+
+    @model.setter
+    def model(self, m: Any) -> None:
+        if self._default_name is None:
+            raise AttributeError(
+                "multi-model server has no single .model; use "
+                "registry.swap(name, model)")
+        # keep_old=False: the legacy contract REPLACED the model —
+        # repeated assignments must not accumulate resident versions
+        self.registry.swap(self._default_name, m, warm=False,
+                           drain=False, keep_old=False)
+
+    def update_model(self, model: Any, version: Optional[str] = None,
+                     warm: bool = True) -> str:
+        """Hot-swap the default model's serving version without
+        dropping connections (reference: cluster serving's model-update
+        flow — a new model version replaced the loaded one between
+        batches).  Rides :meth:`ModelRegistry.swap`: the incoming model
+        is WARMED first (``InferenceModel.warm_from`` AOT-compiles the
+        active version's realized shape buckets, so the first post-swap
+        batches don't eat cold XLA compiles — the pre-registry
+        implementation just assigned ``self.model`` and stalled on a
+        fresh compile per bucket), then the active version flips
+        atomically; in-flight batches finish on the old version.
+        Returns the new version string.  ``warm=False`` restores the
+        raw cold flip."""
+        if self._default_name is None:
+            raise ValueError(
+                "multi-model server: use registry.swap(name, model)")
+        # keep_old=False preserves the legacy replace-in-place memory
+        # behavior: a server refreshed via update_model for months must
+        # hold ONE resident model, not every version ever served.
+        # In-flight batches still finish on the old model (each
+        # assembled batch holds its own reference); use registry.swap
+        # directly to retain old versions for canary pins.
+        ver = self.registry.swap(self._default_name, model,
+                                 version=version, warm=warm,
+                                 drain=False, keep_old=False)
+        logger.info("ClusterServing model updated (version %s)", ver)
+        return ver
 
     def stats(self) -> Dict[str, Any]:
         """Service counters: requests seen, replies sent, batches run,
@@ -342,11 +461,15 @@ class ClusterServing:
         c["mean_batch_size"] = (c.pop("batch_rows") / c["batches"]
                                 if c["batches"] else 0.0)
         with self._pending_lock:
-            c["pending"] = len(self._pending)
+            # scheduler-held rows (continuous batching's backlog) are
+            # out of _pending but still in flight from the client's view
+            c["pending"] = len(self._pending) + self.scheduler.backlog()
         c["queue_depth"] = self._m_depth.value
         c["queue_depth_max"] = self._m_depth.max
         c["inference_workers"] = self.inference_workers
         c["state"] = self.state
+        c["scheduler"] = self.scheduler.name
+        c["models"] = self.registry.stats()
         return c
 
     @property
@@ -390,8 +513,10 @@ class ClusterServing:
         for t in self._threads:
             t.start()
         logger.info("ClusterServing listening on %s:%d (batch=%d, "
-                    "inference_workers=%d, native queue=%s)", self.host,
+                    "inference_workers=%d, scheduler=%s, models=%s, "
+                    "native queue=%s)", self.host,
                     self.port, self.batch_size, self.inference_workers,
+                    self.scheduler.name, self.registry.names(),
                     self._queue.is_native)
         return self
 
@@ -435,6 +560,7 @@ class ClusterServing:
         if self._stop.is_set():
             return
         self._stop.set()
+        self.registry.off_unload(self._retire_model_series)
         self._workers_done.set()
         self._queue.close()
         with self._threads_lock:
@@ -464,6 +590,10 @@ class ClusterServing:
         if self._stop.is_set():
             return
         self._stop.set()
+        # a prebuilt registry outlives this server: drop our unload
+        # observer or every rolling restart leaks a hook retaining the
+        # whole stopped server
+        self.registry.off_unload(self._retire_model_series)
         self._queue.close()
         try:
             # close() alone does NOT wake a thread blocked in accept() on
@@ -506,13 +636,18 @@ class ClusterServing:
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
-        # drain (b): assembled but never inferred — left in the internal
+        # drain (b): admitted by the scheduler but never dispatched —
+        # parked in its local backlog (continuous batching holds rows
+        # there between fill and admit)
+        pending.extend(self.scheduler.drain_rows())
+        # drain (c): assembled but never inferred — left in the internal
         # batch queue because a worker timed out or stop raced dispatch
         while True:
             try:
                 ab = self._batch_q.get_nowait()
             except queue_mod.Empty:
                 break
+            self._finish_batch(ab)
             pending.extend(ab.group)
         # health probes pending in the queue get a terminal pong (they
         # never counted as requests, so no error/drained accounting)
@@ -616,6 +751,27 @@ class ClusterServing:
                             {"uuid": uid, "trace": tid,
                              "error": "no tensor in request"}))
                     continue
+                # model routing: validate at the door (an unroutable
+                # request costs a reply, not a queue slot); the raw
+                # header fields ride the _Pending so assembly re-resolves
+                # against the version active at dispatch time.
+                # Fast path: default traffic with no version pin is
+                # always routable (the default entry always has an
+                # active version) — skip the registry-lock round trip
+                # that would otherwise serialize every conn thread.
+                mname = header.get("model")
+                mver = header.get("version")
+                bad = (None if (mname is None and mver is None
+                                and self._default_name is not None)
+                       else self.registry.route_error(
+                           mname if mname is not None
+                           else self._default_name, mver))
+                if bad is not None:
+                    self._count(errors=1, unknown_model=1)
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "trace": tid, "error": bad}))
+                    continue
                 # deadline_ms is a RELATIVE budget re-anchored at arrival:
                 # client and server clocks never need to agree
                 deadline_ms = header.get("deadline_ms")
@@ -633,7 +789,8 @@ class ClusterServing:
                     self._next_id += 1
                     self._pending[rid] = _Pending(uid, arr, conn, send_lock,
                                                   writer, expires,
-                                                  trace=tid)
+                                                  trace=tid, model=mname,
+                                                  version=mver)
                 # occupancy BEFORE the push: the assembly stage may pop
                 # (and decrement) the instant push returns, and a +1 that
                 # lands after the -1 would miss the high-water mark
@@ -679,7 +836,11 @@ class ClusterServing:
           at the door costs the client nothing and the queue no slot.
           Only applies while requests are actually queued (depth >= 1):
           an idle server's stale EWMA must not reject a fresh burst."""
-        depth = self._m_depth.value
+        # rows the continuous scheduler eagerly pulled into its backlog
+        # are load the native-queue gauge no longer sees — without them
+        # the gate admits into a saturated replica the router should
+        # have failed over from (same correction stats() makes)
+        depth = self._m_depth.value + self.scheduler.backlog()
         if (self.admission_queue_limit is not None
                 and depth >= self.admission_queue_limit):
             return "queue full (admission limit)"
@@ -723,59 +884,69 @@ class ClusterServing:
     # -- stage 2: batch assembly ----------------------------------------------
 
     def _assembly_loop(self) -> None:
-        while not self._stop.is_set():
-            batch: List[_Pending] = []
-            try:
-                item = self._queue.pop(timeout=0.5)
-            except RuntimeError:
-                return
-            if item is None:
-                continue
-            batch.append(self._take(item[0]))
-            # monotonic, not wall-clock: an NTP step backwards would hold
-            # the window open (starving the batch) and a step forwards
-            # would close it instantly on every iteration
-            deadline = time.monotonic() + self.batch_timeout_ms / 1000.0
-            while len(batch) < self.batch_size:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    break
-                try:
-                    item = self._queue.pop(timeout=left)
-                except RuntimeError:
-                    break
-                if item is None:
-                    break
-                batch.append(self._take(item[0]))
-            # injected latency (armed spec's ``delay``) lands HERE, in
-            # the single ordered stage, before shedding — so an armed
-            # delay holds the queue (and expires queued deadlines)
-            # exactly as the pre-pipeline batcher did, regardless of how
-            # many inference workers are idle
-            self._faults.fire("serving.model_latency")
-            batch = [p for p in batch if p is not None]
-            # health probes are answered HERE — from the single ordered
-            # stage, after any armed latency — so a wedged assembly
-            # stage fails the probe by timeout, exactly like a wedged
-            # model would have under the pre-pipeline batcher
-            for p in batch:
-                if p.ping:
-                    self._answer_ping(p)
-            batch = self._shed_expired([p for p in batch if not p.ping])
-            if not batch:
-                continue
-            self._assemble_and_dispatch(batch)
+        # the batching POLICY lives in the scheduler (window /
+        # continuous / custom); this thread just runs it.  The scheduler
+        # owns the native-queue pops and routes every round through
+        # fault-fire → ping answers → deadline shed →
+        # _assemble_and_dispatch (see scheduler.Scheduler._finish_round)
+        self.scheduler.run(self)
 
     def _assemble_and_dispatch(self, batch: List[_Pending]) -> None:
-        """Group by input shape (mixed-shape requests can't stack), stage
-        each group's rows into a pooled buffer, and hand the assembled
-        batches to the inference workers."""
+        """Group by (model, version, input shape) — mixed-shape requests
+        can't stack and mixed-model rows run different executables —
+        stage each group's rows into a pooled buffer, resolve the
+        group's model against the registry (pinning the version active
+        NOW, so a hot swap applies to everything assembled after the
+        flip), and hand the assembled batches to the inference
+        workers."""
         groups: Dict[Tuple, List[_Pending]] = {}
         for p in batch:
-            groups.setdefault(tuple(p.arr.shape) + (str(p.arr.dtype),),
-                              []).append(p)
+            # normalize an absent model to the default name BEFORE
+            # grouping: clients saying model="default" explicitly and
+            # clients saying nothing mean the same executable, and raw
+            # header keys would split them into two half-size batches
+            groups.setdefault(
+                (p.model if p.model is not None else self._default_name,
+                 p.version)
+                + tuple(p.arr.shape) + (str(p.arr.dtype),),
+                []).append(p)
         now = time.monotonic()
+        # resolve each raw group, then MERGE groups that resolved to
+        # the same executable: canary clients pinning the currently-
+        # active version and unpinned clients otherwise split into two
+        # half-size batches every round.  (Raw version pins can't be
+        # normalized at grouping time — resolving the pin there would
+        # let a flip landing mid-round error unpinned rows.)
+        resolved: Dict[Tuple, List] = {}
         for key, group in groups.items():
+            mname, mver = key[0], key[1]
+            try:
+                # begin=True: the in-flight increment happens inside
+                # resolve's lock hold, so a concurrent swap's drain can
+                # never see zero in-flight while this batch is between
+                # resolution and dispatch
+                im, mname, mver = self.registry.resolve(
+                    mname, mver, begin=True)
+            except KeyError as e:
+                # the pinned version (or the whole model) was unloaded
+                # between admission and assembly: explicit error reply,
+                # nothing silently dropped
+                self._count(errors=len(group), unknown_model=len(group))
+                for p in group:
+                    self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
+                                         "error": str(e.args[0])}, None)
+                continue
+            rkey = (mname, mver) + key[2:]
+            entry = resolved.get(rkey)
+            if entry is None:
+                resolved[rkey] = [im, mname, mver, group]
+            else:
+                # duplicate in-flight begin: the merged batch closes
+                # exactly one, so release the extra now (the kept one
+                # holds the count above zero throughout)
+                self.registry.done(mname, mver)
+                entry[3].extend(group)
+        for im, mname, mver, group in resolved.values():
             t0 = time.monotonic()
             buf_key, buf = self._acquire_buf(group[0].arr.shape,
                                              group[0].arr.dtype)
@@ -789,15 +960,68 @@ class ClusterServing:
             assembly_ms = (time.monotonic() - t0) * 1000.0
             self._m_assembly.observe(assembly_ms)
             ab = _AssembledBatch(group, buf[:len(group)], buf_key, buf,
-                                 assembly_ms)
+                                 assembly_ms, im, mname, mver)
             if not self._dispatch(ab):
                 # stopping and nobody will run it: explicit drain reply
+                self._finish_batch(ab)
                 self._release_buf(ab)
                 self._count(errors=len(group), drained=len(group))
                 for p in group:
                     self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
                                          "error": "server shutting down"},
                                      None)
+
+    def _finish_batch(self, ab: _AssembledBatch) -> None:
+        """Close the registry's in-flight accounting for ``ab`` — the
+        version-drain substrate behind ``ModelRegistry.swap``.
+        Idempotent: dispatch-failure, worker and stop()-drain paths may
+        all reach the same batch."""
+        if not ab._done:
+            ab._done = True
+            self.registry.done(ab.model, ab.version)
+
+    def _retire_model_series(self, name: str, version: str) -> None:
+        """Registry unload hook: drop the (name, version) handle-cache
+        entry and its ``server.requests{model=,version=}`` series.  The
+        per-model ``server.batch_size{model=}`` series is shared across
+        versions and deliberately NOT retired — an entry always keeps
+        an active version (unload refuses it), so model names — unlike
+        monotone refresh-swap version strings — are a bounded set."""
+        self._m_model_series.pop((name, version), None)
+        self._metrics.remove("server.requests", model=name,
+                             version=version)
+
+    def _model_series(self, name: str, version: str) -> Tuple:
+        """Cached per-(model, version) labeled handles:
+        ``server.requests{model=,version=}`` and
+        ``server.batch_size{model=}``.
+
+        A cache MISS for an already-unloaded version (a batch still in
+        flight across a ``drain=False`` refresh swap) gets working but
+        UNREGISTERED handles — re-registering would resurrect the
+        series the unload hook just retired, permanently, since the
+        hook never fires for that version again."""
+        key = (name, version)
+        h = self._m_model_series.get(key)
+        if h is None:
+            if version not in self.registry.versions(name):
+                return (metrics_lib.Counter("server.requests", (),
+                                            self._metrics),
+                        metrics_lib.Histogram(
+                            "server.batch_size", (), self._metrics,
+                            buckets=metrics_lib.SIZE_BUCKETS))
+            h = (self._metrics.counter("server.requests", model=name,
+                                       version=version),
+                 self._metrics.histogram(
+                     "server.batch_size",
+                     buckets=metrics_lib.SIZE_BUCKETS, model=name))
+            self._m_model_series[key] = h
+            if version not in self.registry.versions(name):
+                # lost the race with a concurrent unload whose retire
+                # hook ran between our check and the registration:
+                # retire again (idempotent) — h keeps working unscraped
+                self._retire_model_series(name, version)
+        return h
 
     def _dispatch(self, ab: _AssembledBatch) -> bool:
         """Blocking put with a bounded post-stop grace window (workers
@@ -907,6 +1131,8 @@ class ClusterServing:
                 for p in ab.group:
                     self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
                                          "error": str(e)}, None)
+            finally:
+                self._finish_batch(ab)
 
     def _run_batch(self, ab: _AssembledBatch) -> None:
         # a batch can sit in the internal queue past its rows' deadlines:
@@ -929,9 +1155,13 @@ class ClusterServing:
             x = buf[:len(group)]
         self._count(batches=1, batch_rows=len(group))
         self._m_batch_size.observe(len(group))
+        # per-model labeled series (the unlabeled ones above aggregate)
+        m_req, m_bs = self._model_series(ab.model, ab.version)
+        m_req.inc(len(group))
+        m_bs.observe(len(group))
         t_inf = time.monotonic()
         try:
-            out = np.asarray(self.model.predict(x))
+            out = np.asarray(ab.im.predict(x))
             infer_ms = (time.monotonic() - t_inf) * 1000.0
             if np.may_share_memory(out, x):
                 # a pass-through-ish model returned (a view of) its
@@ -957,8 +1187,16 @@ class ClusterServing:
                         "server.inference_ms": round(infer_ms, 3),
                         "server.batch_size": len(group)}
                     trace_lib.record(p.trace, "server.batch", stages)
-                self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
-                                     "stages": stages}, row)
+                hdr = {"uuid": p.uuid, "trace": p.trace,
+                       "stages": stages}
+                if p.model is not None:
+                    # name the (resolved) serving version only for
+                    # requests that routed by model explicitly — the
+                    # default traffic's reply frames stay byte-identical
+                    # to the pre-registry server for bisection
+                    hdr["model"] = ab.model
+                    hdr["version"] = ab.version
+                self._send_reply(p, hdr, row)
         except Exception as e:  # noqa: BLE001 — report to the client
             logger.warning("inference failed: %s", e)
             self._release_buf(ab)
@@ -994,8 +1232,20 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     parser = argparse.ArgumentParser(prog="zoo-serving",
                                      description=main.__doc__)
-    parser.add_argument("--model-dir", required=True,
-                        help="a ZooModel.save_model directory")
+    parser.add_argument("--model-dir", default=None,
+                        help="a ZooModel.save_model directory (the "
+                             "'default' model)")
+    parser.add_argument("--model", action="append", default=None,
+                        metavar="NAME=DIR",
+                        help="additional named model(s) for multi-model "
+                             "serving; repeatable")
+    parser.add_argument("--scheduler", default=None,
+                        choices=sorted(scheduler_lib.SCHEDULERS),
+                        help="assembly batching policy (default: "
+                             "ZooConfig.scheduler, window)")
+    parser.add_argument("--config", default=None,
+                        help="ZooConfig JSON/YAML file; its serving "
+                             "fields (scheduler, models) seed the flags")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8980)
     parser.add_argument("--batch-size", type=int, default=16)
@@ -1006,10 +1256,31 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="also serve HTTP/JSON on this port")
     args = parser.parse_args(argv)
 
-    model = InferenceModel().load_zoo_model(args.model_dir)
+    cfg = None
+    if args.config is not None:
+        from analytics_zoo_tpu.core.config import ZooConfig
+        cfg = ZooConfig.from_file(args.config)
+    models = {}
+    for spec in args.model or []:
+        name, sep, mdir = spec.partition("=")
+        if not sep or not name or not mdir:
+            parser.error(f"--model expects NAME=DIR, got {spec!r}")
+        models[name] = InferenceModel().load_zoo_model(mdir)
+    if cfg is not None:
+        for name, mdir in (cfg.models or {}).items():
+            models.setdefault(name,
+                              InferenceModel().load_zoo_model(mdir))
+    model = (InferenceModel().load_zoo_model(args.model_dir)
+             if args.model_dir else None)
+    if model is None and not models:
+        parser.error("at least one of --model-dir / --model / a config "
+                     "with models is required")
+    scheduler = args.scheduler or (cfg.scheduler if cfg else None)
     serving = ClusterServing(model, host=args.host, port=args.port,
                              batch_size=args.batch_size,
-                             inference_workers=args.inference_workers
+                             inference_workers=args.inference_workers,
+                             scheduler=scheduler,
+                             models=models or None,
                              ).start()
     frontend = None
     if args.http_port is not None:

@@ -8,6 +8,10 @@ curve:
 - serving: closed-loop throughput with ``inference_workers=2`` must not
   fall below the ``inference_workers=1`` baseline (and with a
   compute-bound stub it should clearly exceed it);
+- scheduling (ISSUE 6): ``ContinuousScheduler`` must meet or beat the
+  ``WindowScheduler`` on closed-loop throughput at saturation, and cut
+  p50 at light load (the window tail is pure latency when the batch
+  can't fill);
 - training: ``fit(prefetch=2)`` must cut ``train.data_wait_ms`` versus
   ``prefetch=0`` on a throttled feed.
 """
@@ -76,6 +80,61 @@ def test_pipelined_serving_throughput_beats_single_worker():
     # workers should land near 2x, so 1.4x keeps the guard meaningful
     # while riding out CI scheduling noise
     assert qps2 >= qps1 * 1.4, (qps1, qps2)
+
+
+def _scheduler_sweep(scheduler: str, clients: int,
+                     duration_s: float = 2.0):
+    """Closed-loop (QPS, p50_ms) through a model-bound stub under the
+    given scheduler.  batch_size > clients so the window batcher can
+    never fill a batch — its ``batch_timeout_ms`` tail is pure latency
+    the continuous scheduler does not pay."""
+    lat = []
+    with ClusterServing(_BusyModel(0.01), batch_size=8,
+                        batch_timeout_ms=20, inference_workers=2,
+                        scheduler=scheduler) as srv:
+        deadline = time.monotonic() + duration_s
+
+        def client(i):
+            iq = InputQueue(srv.host, srv.port)
+            oq = OutputQueue(input_queue=iq)
+            while time.monotonic() < deadline:
+                t0 = time.monotonic()
+                uid = iq.enqueue(f"c{i}", t=np.ones((4,), np.float32))
+                if oq.query(uid, timeout=30.0) is not None:
+                    lat.append(time.monotonic() - t0)
+            iq.close()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        wall = time.monotonic() - t0
+    ms = sorted(t * 1000.0 for t in lat)
+    return len(lat) / wall, ms[len(ms) // 2]
+
+
+def test_continuous_scheduler_meets_window_throughput_at_saturation():
+    """4 closed-loop clients against batch_size=8: the window batcher
+    waits out its 20 ms timeout every round (the batch can never fill),
+    the continuous scheduler dispatches the moment a worker frees — so
+    continuous must at least MATCH window throughput (it should far
+    exceed it in this regime)."""
+    qps_w, _ = _scheduler_sweep("window", clients=4)
+    qps_c, _ = _scheduler_sweep("continuous", clients=4)
+    assert qps_c >= qps_w, (qps_w, qps_c)
+
+
+def test_continuous_scheduler_cuts_p50_at_light_load():
+    """A lone client's request has nothing to batch with: the window
+    scheduler still holds the batch open for ``batch_timeout_ms``; the
+    continuous scheduler's p50 must come in clearly below it."""
+    _, p50_w = _scheduler_sweep("window", clients=1)
+    _, p50_c = _scheduler_sweep("continuous", clients=1)
+    assert p50_w >= 20.0, p50_w  # the tail really bit the baseline
+    assert p50_c < p50_w * 0.8, (p50_w, p50_c)
 
 
 def test_prefetch_cuts_data_wait_on_throttled_feed():
