@@ -106,6 +106,14 @@ class ZooConfig:
     # device compute of step k.  0 = iterate the feed inline (the
     # pre-pipeline behavior, for bisection).
     prefetch: int = 2
+    # streaming input pipeline (data/stream.py): decode-worker backend —
+    # "thread" (default; bisection-safe, byte-identical batches) or
+    # "process" (multi-process decode writing into a shared-memory slot
+    # pool; scales GIL-bound decode/augment across host cores) — and the
+    # default worker count (None = 4).  Per-feed overrides:
+    # StreamingDataFeed(workers=..., num_workers=...).
+    feed_backend: str = "thread"
+    feed_workers: Optional[int] = None
 
     # serving hot path (serving/server.py pipeline)
     # concurrent model-call threads pulling assembled batches; bounded

@@ -220,6 +220,35 @@ class FaultRegistry:
         if self.fire(name):
             raise exc(message)
 
+    def absorb(self, name: str, hits: int = 0, fired: int = 0) -> None:
+        """Fold hit/fire counts observed in FORKED worker processes back
+        into this (parent) registry.  A forked child inherits the armed
+        specs copy-on-write, so its fire decisions are deterministic but
+        its counter updates and ``times`` charges land in the child's
+        copy only — the streaming feed's process backend mirrors them
+        through shared memory and calls this at epoch end, so
+        ``fired()``, the ``faults.fired`` metric, and auto-disarm on an
+        exhausted ``times`` budget stay coherent with the thread
+        backend.  (With several children each holding its own copy of a
+        bounded spec the total can overshoot ``times``; the budget is
+        consumed by the TOTAL fired count, clamped at disarm.)"""
+        if hits <= 0 and fired <= 0:
+            return
+        with self._lock:
+            if hits > 0:
+                self._hits[name] = self._hits.get(name, 0) + hits
+            if fired > 0:
+                self._fired[name] = self._fired.get(name, 0) + fired
+                spec = self._specs.get(name)
+                if spec is not None and spec.times is not None:
+                    spec.times -= fired
+                    if spec.times <= 0:
+                        del self._specs[name]
+        if fired > 0:
+            from . import metrics as metrics_lib
+            metrics_lib.get_registry().inc("faults.fired", fired,
+                                           point=name)
+
     # -- observability --------------------------------------------------------
 
     def hits(self, name: str) -> int:

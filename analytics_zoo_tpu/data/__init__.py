@@ -2,9 +2,13 @@
 
 from .feed import (DataFeed, PrefetchIterator, as_feed, batch_sharding,
                    shard_batch)
-from .readers import read_csv, read_json, read_npz, read_parquet
+from .readers import (FileReadahead, read_csv, read_json, read_npz,
+                      read_parquet)
 from .shards import XShards
-from .stream import StreamingDataFeed
+from .stream import StreamingDataFeed, make_placer
+from .shm_pool import ShmBatchPool, SlotBatch
+from .augment import (DeviceAugment, DeviceNormalize, DeviceRandomCrop,
+                      DeviceRandomFlip)
 from .image import (ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop,
                     ImageRandomFlip, ImageNormalize, ImageBrightness,
                     ImageContrast, ImageSaturation, ImageColorJitter)
@@ -19,7 +23,9 @@ __all__ = [
     "XShards", "DataFeed", "PrefetchIterator", "as_feed", "batch_sharding",
     "shard_batch",
     "read_csv", "read_json", "read_npz", "read_parquet", "pandas",
-    "StreamingDataFeed", "ImageSet", "ImageResize", "ImageCenterCrop",
+    "FileReadahead", "StreamingDataFeed", "make_placer", "ShmBatchPool",
+    "SlotBatch", "DeviceAugment", "DeviceNormalize", "DeviceRandomCrop",
+    "DeviceRandomFlip", "ImageSet", "ImageResize", "ImageCenterCrop",
     "ImageRandomCrop", "ImageRandomFlip", "ImageNormalize", "ImageBrightness",
     "ImageContrast", "ImageSaturation", "ImageColorJitter", "TextSet",
     "IterableDataFeed", "from_iterator", "from_tf_dataset",

@@ -1,0 +1,137 @@
+"""DeviceAugment: batch-level fused augmentation inside the jit step.
+
+The host-side ``ImageProcessing`` chain (data/image.py) normalizes each
+image to float32 on the CPU, which makes the host→device payload 4×
+larger than the decoded uint8 pixels and burns decode-worker cycles on
+arithmetic an accelerator does for free.  This module is the device half
+of the split the streaming input pipeline wants:
+
+- host workers only DECODE (file bytes → uint8 HWC), so the feed ships
+  compact ``uint8`` NHWC batches over PCIe/ICI;
+- normalize / random-crop / flip run ON DEVICE as part of the
+  jit-compiled train step (``ZooEstimator(augment=...)``), fused by XLA
+  into the first conv's prologue — per-step cost is effectively the
+  memory read the step does anyway.
+
+Randomness is functional: the estimator passes a per-step PRNG key
+(folded from the train step's rng), each stage folds in its chain index,
+and per-image decisions are drawn with batch-shaped draws — so
+augmentation is reproducible from the seed and independent of host
+worker scheduling (unlike the host chain, whose rng stream depends on
+which worker decoded which batch).
+
+Stages mirror the host chain (``ImageNormalize``/``ImageRandomCrop``/
+``ImageRandomFlip``) closely enough that moving a pipeline from host to
+device is a drop-in swap; at eval time (``training=False``) random
+stages become deterministic (center crop, no flip) while shape-changing
+behavior is preserved so the model always sees one static shape.
+
+Everything here is pure ``jax.numpy`` — jit/vmap/scan composable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DeviceAugment", "DeviceNormalize", "DeviceRandomCrop",
+           "DeviceRandomFlip"]
+
+
+class DeviceNormalize:
+    """uint8 NHWC → float32, ``(x/255 - mean) / std`` per channel — the
+    device mirror of ``ImageNormalize`` (same constants, same order of
+    operations, so a host-normalized and a device-normalized pipeline
+    reach loss parity)."""
+
+    random = False
+
+    def __init__(self, mean: Sequence[float] = (0.485, 0.456, 0.406),
+                 std: Sequence[float] = (0.229, 0.224, 0.225)):
+        self.mean = tuple(float(m) for m in mean)
+        self.std = tuple(float(s) for s in std)
+
+    def __call__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                 training: bool = True) -> jax.Array:
+        mean = jnp.asarray(self.mean, jnp.float32)
+        std = jnp.asarray(self.std, jnp.float32)
+        return (x.astype(jnp.float32) / 255.0 - mean) / std
+
+
+class DeviceRandomCrop:
+    """Per-image random (h, w) crop at train time, center crop at eval —
+    the device mirror of ``ImageRandomCrop``/``ImageCenterCrop``.  The
+    output shape is static (``[B, h, w, C]``) either way, so the jit
+    step compiles once."""
+
+    random = True
+
+    def __init__(self, h: int, w: int):
+        self.h, self.w = int(h), int(w)
+
+    def __call__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                 training: bool = True) -> jax.Array:
+        ih, iw = x.shape[1], x.shape[2]
+        if ih < self.h or iw < self.w:
+            raise ValueError(
+                f"DeviceRandomCrop({self.h}, {self.w}) got {ih}x{iw} "
+                f"images — resize on the host first")
+        if not training or key is None:
+            top = (ih - self.h) // 2
+            left = (iw - self.w) // 2
+            return x[:, top:top + self.h, left:left + self.w]
+        kh, kw = jax.random.split(key)
+        tops = jax.random.randint(kh, (x.shape[0],), 0, ih - self.h + 1)
+        lefts = jax.random.randint(kw, (x.shape[0],), 0, iw - self.w + 1)
+
+        def crop(img, t, l):
+            return jax.lax.dynamic_slice(
+                img, (t, l, 0), (self.h, self.w, img.shape[2]))
+
+        return jax.vmap(crop)(x, tops, lefts)
+
+
+class DeviceRandomFlip:
+    """Per-image horizontal flip with probability ``p`` at train time
+    (no-op at eval) — the device mirror of ``ImageRandomFlip``."""
+
+    random = True
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                 training: bool = True) -> jax.Array:
+        if not training or key is None:
+            return x
+        coin = jax.random.bernoulli(key, self.p, (x.shape[0],))
+        return jnp.where(coin[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+class DeviceAugment:
+    """A jit-composable chain of device augmentation stages.
+
+    ``DeviceAugment([DeviceRandomCrop(224, 224), DeviceRandomFlip(),
+    DeviceNormalize()])(x, key, training)`` — each stage receives
+    ``jax.random.fold_in(key, stage_index)`` so adding or reordering
+    stages never silently reuses another stage's randomness.  With
+    ``key=None`` or ``training=False`` the chain is deterministic
+    (center crops, no flips, normalize applies) — what ``evaluate`` /
+    ``predict`` use.
+    """
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def __call__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                 training: bool = True) -> jax.Array:
+        for i, stage in enumerate(self.stages):
+            k = None if key is None else jax.random.fold_in(key, i)
+            x = stage(x, k, training)
+        return x
+
+    def __repr__(self) -> str:
+        names = ", ".join(type(s).__name__ for s in self.stages)
+        return f"DeviceAugment([{names}])"

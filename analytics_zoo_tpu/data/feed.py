@@ -274,6 +274,17 @@ class PrefetchIterator:
     is genuinely slower than the step, which is exactly what the
     ``train.data_wait_ms`` histogram should measure.
 
+    ``place``: optional callable applied to every item INSIDE the
+    producer thread (e.g. ``stream.make_placer(mesh)`` = ``shard_batch``
+    over host batches).  With ``depth >= 2`` this is double-buffered
+    ``device_put``: the host→HBM copy of batch N+1 is dispatched — and
+    completes — while the device computes batch N.  Items carrying a
+    ``release()`` handle (shared-memory pool slots from the streaming
+    feed's process backend) are retired one item behind the placement:
+    once the NEXT item is dispatched, the previous transfer is synced
+    (its unhidden tail observed as ``feed.h2d_ms``) and the slot
+    recycled.
+
     Exceptions from the producer (loader failures, injected
     ``feed.stall``-adjacent faults) re-raise in the consumer at the
     position they occurred.  ``close()`` is safe mid-epoch (rollback,
@@ -284,12 +295,17 @@ class PrefetchIterator:
     _END = object()
 
     def __init__(self, it: Iterator, depth: int = 2,
-                 gauge: Optional[Any] = None):
+                 gauge: Optional[Any] = None,
+                 place: Optional[Any] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._it = iter(it)
         self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
         self._gauge = gauge  # e.g. the train.prefetch_depth gauge
+        self._place = place
+        self._staged = None  # (placed, releasable_raw, dispatch_ms)
+        self._m_h2d = (_metrics_lib.get_registry().histogram("feed.h2d_ms")
+                       if place is not None else None)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._produce, daemon=True,
                                         name="zoo-prefetch")
@@ -306,13 +322,48 @@ class PrefetchIterator:
                 continue
         return False
 
+    def _stage(self, raw: Any) -> Any:
+        """Dispatch the device copy of THIS item, then retire the
+        previous one (sync its transfer tail, recycle its pool slot) —
+        the one-item lag is what guarantees a slot is never reused
+        while its bytes are still in flight to the device."""
+        t0 = time.monotonic()
+        placed = self._place(raw)
+        disp_ms = (time.monotonic() - t0) * 1000.0
+        self._retire()
+        self._staged = (placed, raw if hasattr(raw, "release") else None,
+                        disp_ms)
+        return placed
+
+    def _retire(self) -> None:
+        # producer-thread only (close() leaves the last slot to the
+        # SlotBatch GC safety net rather than racing the producer)
+        staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        placed, raw, disp_ms = staged
+        if raw is not None:
+            t0 = time.monotonic()
+            jax.block_until_ready(placed)
+            if self._m_h2d is not None:
+                self._m_h2d.observe(
+                    disp_ms + (time.monotonic() - t0) * 1000.0)
+            raw.release()
+        elif self._m_h2d is not None:
+            # no slot to recycle (thread backend): no forced sync, but
+            # the dispatch half keeps per-backend h2d comparable
+            self._m_h2d.observe(disp_ms)
+
     def _produce(self) -> None:
         try:
             for batch in self._it:
+                if self._place is not None:
+                    batch = self._stage(batch)
                 if not self._put(("item", batch)):
                     return  # closed mid-epoch
                 if self._stop.is_set():
                     return
+            self._retire()
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._put(("error", e))
             return

@@ -20,6 +20,7 @@ a list, matching the reference's ImageProcessing pipeline composition.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -172,6 +173,15 @@ class ImageColorJitter:
         return img
 
 
+def decode_image_bytes(data: bytes) -> np.ndarray:
+    """Raw file bytes → uint8 HWC RGB — the decode half of the
+    readahead split (readers.FileReadahead fetches the bytes)."""
+    import io
+    from PIL import Image
+    with Image.open(io.BytesIO(data)) as im:
+        return np.asarray(im.convert("RGB"))
+
+
 def decode_image(path: str) -> np.ndarray:
     """File → uint8 HWC RGB (reference: OpenCV imdecode behind JNI; here
     PIL on the host — the chip never sees undecoded bytes)."""
@@ -216,12 +226,18 @@ class ImageSet:
     def __init__(self, paths: Sequence[str],
                  labels: Optional[Sequence[int]] = None,
                  transforms: Optional[List[Callable]] = None,
-                 class_names: Optional[List[str]] = None):
+                 class_names: Optional[List[str]] = None,
+                 readahead: int = 0):
         self.paths = list(paths)
         self.labels = None if labels is None else np.asarray(labels,
                                                              np.int32)
         self.transforms = list(transforms or [])
         self.class_names = class_names
+        # raw-file readahead depth (0 = off): decode workers hint each
+        # batch's paths ahead of decoding it, so storage reads overlap
+        # decode (readers.FileReadahead; one reader per worker process)
+        self.readahead = int(readahead)
+        self._ra_lock = threading.Lock()
 
     @staticmethod
     def read(path: str, with_label: bool = True,
@@ -267,26 +283,77 @@ class ImageSet:
 
     # -- materialization paths ------------------------------------------------
 
+    # -- streaming-feed loader protocols (data/stream.py duck-types these
+    # off ``load_sample.__self__``) ------------------------------------------
+
+    def _reader(self):
+        """This worker's FileReadahead, created lazily and keyed on pid
+        so a forked decode worker never inherits a dead reader thread.
+        Creation is locked: concurrent worker THREADS racing the first
+        hint must share one instance (every loser would otherwise leak a
+        parked reader thread and duplicate its queued reads)."""
+        ra = self.__dict__.get("_ra")
+        if ra is not None and ra.pid == os.getpid():
+            return ra
+        from .readers import FileReadahead
+        with self._ra_lock:
+            ra = self.__dict__.get("_ra")
+            if ra is None or ra.pid != os.getpid():
+                ra = FileReadahead(depth=max(1, self.readahead))
+                self.__dict__["_ra"] = ra
+            return ra
+
+    def hint_indices(self, indices: Sequence[int]) -> None:
+        """Advisory from the streaming feed: these rows decode next."""
+        if self.readahead:
+            self._reader().hint([self.paths[i] for i in indices])
+
+    def feed_stats(self) -> Dict[str, float]:
+        """Cumulative blocked-on-storage ms for the calling worker
+        (surfaced by the feed as ``feed.io_wait_ms``)."""
+        if not self.readahead:
+            return {"io_wait_ms": 0.0}
+        return {"io_wait_ms": self._reader().wait_ms}
+
     def load_sample(self, i: int,
                     rng: Optional[np.random.Generator] = None
                     ) -> Dict[str, np.ndarray]:
-        img = apply_chain(decode_image(self.paths[i]), self.transforms, rng)
+        if self.readahead:
+            img = decode_image_bytes(self._reader().get(self.paths[i]))
+        else:
+            img = decode_image(self.paths[i])
+        img = apply_chain(img, self.transforms, rng)
         out: Dict[str, np.ndarray] = {"x": np.ascontiguousarray(img)}
         if self.labels is not None:
             out["y"] = self.labels[i]
         return out
 
     def to_feed(self, batch_size: int, shuffle: bool = True, seed: int = 0,
-                num_workers: int = 4, prefetch_batches: int = 4,
-                drop_remainder: bool = True):
-        """A StreamingDataFeed that decodes/augments in worker threads and
-        prefetches batches through the native queue."""
+                num_workers: Optional[int] = None,
+                prefetch_batches: int = 4,
+                drop_remainder: bool = True,
+                workers: Optional[str] = None,
+                readahead: Optional[int] = None):
+        """A StreamingDataFeed that decodes/augments in decode workers
+        (``workers=``: "thread" | "process", see data/stream.py) and
+        prefetches batches through the native queue.  ``readahead`` sets
+        the per-worker raw-file readahead depth FOR THIS FEED (None
+        keeps the ImageSet's setting; a different value loads through a
+        shallow copy, so other feeds and direct ``load_sample`` calls on
+        this ImageSet are untouched)."""
+        import copy
         from .stream import StreamingDataFeed
+        owner = self
+        if readahead is not None and int(readahead) != self.readahead:
+            owner = copy.copy(self)       # paths/labels/transforms shared
+            owner.__dict__.pop("_ra", None)
+            owner._ra_lock = threading.Lock()
+            owner.readahead = int(readahead)
         return StreamingDataFeed(
-            num_samples=len(self.paths), load_sample=self.load_sample,
+            num_samples=len(owner.paths), load_sample=owner.load_sample,
             batch_size=batch_size, shuffle=shuffle, seed=seed,
             num_workers=num_workers, prefetch_batches=prefetch_batches,
-            drop_remainder=drop_remainder)
+            drop_remainder=drop_remainder, workers=workers)
 
     def to_shards(self, num_shards: int = 4) -> XShards:
         """Eagerly decode everything into numpy-dict XShards (small sets;

@@ -12,9 +12,12 @@ infeed — no driver hop, no shuffle.
 
 from __future__ import annotations
 
+import collections
 import glob
 import os
-from typing import Any, List, Optional, Sequence
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -23,12 +26,137 @@ import jax
 from .shards import XShards
 
 
+class FileReadahead:
+    """Per-worker raw-file readahead: a background thread reads hinted
+    files' bytes into a bounded cache so storage latency overlaps decode.
+
+    The streaming feed's decode workers hint each batch's file list
+    before decoding it (``StreamingDataFeed`` → ``ImageSet.hint_indices``
+    → ``hint()``); while the worker decodes image k, the reader thread is
+    already pulling image k+1's bytes off storage.  ``get(path)`` returns
+    the cached bytes or — on a miss — reads inline and counts the blocked
+    time, so the fraction of decode wall spent waiting on storage is an
+    honest, per-worker number (``wait_ms`` is thread-local; the feed
+    surfaces deltas as the ``feed.io_wait_ms`` series).
+
+    One instance per worker (thread or forked process): ``ImageSet``
+    creates them lazily keyed on pid, so fork inheritance can never share
+    a dead reader thread.
+    """
+
+    def __init__(self, depth: int = 8):
+        if depth < 1:
+            raise ValueError(f"readahead depth must be >= 1, got {depth}")
+        self.pid = os.getpid()
+        self.depth = depth
+        self._cond = threading.Condition(threading.Lock())
+        self._want: "collections.deque[str]" = collections.deque()
+        self._cache: Dict[str, bytes] = {}
+        self._reading: Optional[str] = None  # path the reader holds now
+        self._drop: set = set()    # in-flight reads the decoder already
+        #                            satisfied inline — discard, don't cache
+        self._tl = threading.local()  # per-caller-thread wait accounting
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def wait_ms(self) -> float:
+        """Cumulative blocked-on-storage ms for the CALLING thread."""
+        return getattr(self._tl, "ms", 0.0)
+
+    def hint(self, paths: Sequence[str]) -> None:
+        """Advise which files are about to be read (drops hints beyond
+        the bound — they fall back to inline reads, never to an
+        unbounded queue)."""
+        with self._cond:
+            if self._closed:
+                return
+            queued = set(self._want)
+            for p in paths:
+                if p in queued or p in self._cache:
+                    continue
+                if len(self._want) >= 4 * self.depth:
+                    break
+                self._want.append(p)
+                queued.add(p)
+            if self._want and self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._read_loop, daemon=True,
+                    name="zoo-readahead")
+                self._thread.start()
+            self._cond.notify_all()
+
+    def get(self, path: str) -> bytes:
+        """The file's bytes: from cache when the readahead won the race,
+        else read inline with the blocked time counted.  A miss RETIRES
+        the path from the readahead's queue (and marks an in-flight read
+        of it for discard): without that, every lost race left a stale
+        never-to-be-requested cache entry behind, and after ``depth`` of
+        them the reader parked forever — readahead silently off."""
+        with self._cond:
+            data = self._cache.pop(path, None)
+            if data is not None:
+                self._cond.notify_all()  # cache slot freed: reader resumes
+                return data
+            try:  # we're reading it ourselves: the hint is stale now
+                self._want.remove(path)
+            except ValueError:
+                pass
+            if self._reading == path:
+                self._drop.add(path)
+        t0 = time.monotonic()
+        with open(path, "rb") as f:
+            data = f.read()
+        self._tl.ms = getattr(self._tl, "ms", 0.0) \
+            + (time.monotonic() - t0) * 1000.0
+        return data
+
+    def _read_loop(self) -> None:
+        while True:
+            with self._cond:
+                self._reading = None
+                while not self._closed and (
+                        not self._want or len(self._cache) >= self.depth):
+                    self._cond.wait()
+                if self._closed:
+                    return
+                path = self._want.popleft()
+                if path in self._cache:
+                    continue
+                self._reading = path
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue  # the decode-side read reports the real error
+            with self._cond:
+                if self._closed:
+                    return
+                if path in self._drop:   # decoder read it inline meanwhile
+                    self._drop.discard(path)
+                    continue
+                self._cache[path] = data
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._want.clear()
+            self._cache.clear()
+            self._cond.notify_all()
+
+
 def _expand(file_path: str, extensions: Sequence[str]) -> List[str]:
+    # extension matching is case-INSENSITIVE: camera exports and legacy
+    # datasets mix ``.CSV``/``.JPG``/``.JPEG`` freely, and a
+    # case-sensitive endswith silently dropped them from globbed
+    # directories (rows just vanished — no error)
+    exts = tuple(e.lower() for e in extensions)
     if os.path.isdir(file_path):
         files = sorted(
             f for f in glob.glob(os.path.join(file_path, "**", "*"),
                                  recursive=True)
-            if os.path.isfile(f) and f.endswith(tuple(extensions)))
+            if os.path.isfile(f) and f.lower().endswith(exts))
     else:
         files = sorted(glob.glob(file_path))
     if not files:

@@ -42,7 +42,8 @@ from analytics_zoo_tpu.core import metrics as telemetry
 from analytics_zoo_tpu.core.context import heartbeat
 from analytics_zoo_tpu.core.summary import SummaryWriter
 from analytics_zoo_tpu.data import (PrefetchIterator, as_feed,
-                                    batch_sharding, shard_batch)
+                                    batch_sharding, make_placer,
+                                    shard_batch)
 from analytics_zoo_tpu.nn import losses as losses_lib
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn.module import Module
@@ -161,7 +162,8 @@ class ZooEstimator:
                  grad_accum: int = 1,
                  checkpoint_retries: int = 3,
                  nan_policy: Optional[str] = None,
-                 nan_max_rollbacks: int = 3):
+                 nan_max_rollbacks: int = 3,
+                 augment: Any = None):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -207,7 +209,16 @@ class ZooEstimator:
         ``warn``/``rollback``/``raise`` read the loss on the host every
         step (one device sync per step); ``skip_step`` does not.  Bad-step
         counts surface as ``history["bad_steps"]`` (per epoch), the
-        ``bad_steps`` summary scalar, and ``est.bad_steps`` (total)."""
+        ``bad_steps`` summary scalar, and ``est.bad_steps`` (total).
+
+        ``augment``: a ``data.DeviceAugment`` chain (or any callable
+        ``(x, key, training) -> x``) compiled INTO the jit steps — the
+        streaming-input split: host workers ship compact uint8 batches,
+        normalize/random-crop/flip run on device, keyed from the train
+        step's per-step rng (reproducible, scheduling-independent).
+        Train steps run the chain with a fresh fold of the step rng;
+        evaluate/predict run it deterministically (center crop, no flip,
+        normalize applies)."""
         self.model = model
         self.loss_fn = losses_lib.get(loss)
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
@@ -230,6 +241,7 @@ class ZooEstimator:
                              f"or None, got {nan_policy!r}")
         self.nan_policy = nan_policy
         self.nan_max_rollbacks = max(0, nan_max_rollbacks)
+        self.augment = augment
         self.bad_steps = 0       # total non-finite steps seen (host mirror)
         self._rollbacks = 0
         self._writer = (SummaryWriter(log_dir, app_name)
@@ -287,6 +299,11 @@ class ZooEstimator:
             return
         mesh = get_mesh()
         rng = jax.random.PRNGKey(self.seed)
+        if self.augment is not None:
+            # the model sees POST-augment batches (a crop changes the
+            # spatial shape); init with the deterministic chain so the
+            # parameter shapes match what the train step applies
+            example_x = self.augment(example_x, None, training=False)
         # init under jit: ONE compiled program instead of hundreds of
         # eager per-op dispatches.  Eager init was (a) the trigger surface
         # for an intermittent native abort in XLA:CPU under dispatch load
@@ -333,11 +350,18 @@ class ZooEstimator:
         accum = self.grad_accum
         guard_skip = self.nan_policy == "skip_step"
         guard_host = self.nan_policy in ("warn", "rollback", "raise")
+        aug = self.augment
 
         def train_step(ts, batch):
             step_rng = jax.random.fold_in(ts["rng"], ts["step"])
 
             def lossf(params, xb, yb, state, rng):
+                if aug is not None:
+                    # device-side fused augmentation (data/augment.py):
+                    # uint8 batch in, keyed per step — XLA fuses the
+                    # normalize into the first layer's prologue
+                    a_rng, rng = jax.random.split(rng)
+                    xb = aug(xb, a_rng, training=True)
                 out, new_state = model.apply(
                     {"params": params, "state": state}, xb,
                     training=True, rng=rng)
@@ -414,8 +438,11 @@ class ZooEstimator:
             return new_ts, loss_val
 
         def eval_step(ts, batch):
+            xb = batch["x"]
+            if aug is not None:
+                xb = aug(xb, None, training=False)
             out, _ = model.apply({"params": ts["params"],
-                                  "state": ts["state"]}, batch["x"],
+                                  "state": ts["state"]}, xb,
                                  training=False)
             mask = batch.get("mask")
             if mask is None:
@@ -431,6 +458,8 @@ class ZooEstimator:
             return stats
 
         def pred_step(ts, x):
+            if aug is not None:
+                x = aug(x, None, training=False)
             out, _ = model.apply({"params": ts["params"],
                                   "state": ts["state"]}, x, training=False)
             return out
@@ -533,15 +562,28 @@ class ZooEstimator:
                 epoch_wait = 0.0
                 bad_before = self.bad_steps
                 rolled_back = False
-                batch_iter = iter(feed.epoch(mesh, self._epoch))
-                if prefetch and prefetch > 0:
+                if prefetch and prefetch > 0 and _supports_host_epoch(
+                        feed):
+                    # stream feeds: iterate HOST batches and place them
+                    # inside the prefetch producer — double-buffered
+                    # device_put: the host→HBM copy of batch k+1
+                    # dispatches (and completes) while the device
+                    # computes batch k, and shared-memory pool slots
+                    # recycle the moment their transfer lands
+                    batch_iter = PrefetchIterator(
+                        feed.epoch(mesh, self._epoch, place=False),
+                        depth=prefetch, gauge=m_prefetch,
+                        place=make_placer(mesh))
+                elif prefetch and prefetch > 0:
                     # depth-2 double buffering by default: the feed's
                     # host work for step k+1 (slice/stack, shard_batch,
                     # device_put dispatch) overlaps the device compute
                     # of step k on a background thread
-                    batch_iter = PrefetchIterator(batch_iter,
-                                                  depth=prefetch,
-                                                  gauge=m_prefetch)
+                    batch_iter = PrefetchIterator(
+                        iter(feed.epoch(mesh, self._epoch)),
+                        depth=prefetch, gauge=m_prefetch)
+                else:
+                    batch_iter = iter(feed.epoch(mesh, self._epoch))
                 try:
                     while True:
                         t_fetch = time.monotonic()
@@ -948,6 +990,17 @@ class ZooEstimator:
 
 def _first_leaf(tree: Any) -> jax.Array:
     return jax.tree_util.tree_leaves(tree)[0]
+
+
+def _supports_host_epoch(feed: Any) -> bool:
+    """Can this feed yield host batches (``epoch(..., place=False)``)?
+    True for StreamingDataFeed; in-RAM feeds keep their own placed-epoch
+    double buffering."""
+    try:
+        import inspect
+        return "place" in inspect.signature(feed.epoch).parameters
+    except (TypeError, ValueError):
+        return False
 
 
 def _poison_batch(batch: Dict[str, Any]) -> Dict[str, Any]:

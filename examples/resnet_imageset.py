@@ -51,6 +51,15 @@ def main() -> None:
                         help="class-per-subdir image folder (default: "
                              "synthetic)")
     parser.add_argument("--num-workers", type=int, default=4)
+    parser.add_argument("--feed-backend", default="thread",
+                        choices=("thread", "process"),
+                        help="decode-worker backend: 'process' scales "
+                             "GIL-bound decode across host cores via the "
+                             "shared-memory slot pool (data/shm_pool.py)")
+    parser.add_argument("--readahead", type=int, default=0,
+                        help="per-worker raw-file readahead depth "
+                             "(0 = off): overlaps storage reads with "
+                             "decode")
     args = parser.parse_args()
 
     from analytics_zoo_tpu.core import init_orca_context, stop_orca_context
@@ -86,7 +95,9 @@ def main() -> None:
             optimizer="adam", learning_rate=1e-3, metrics=["accuracy"])
         # streaming feed: decode/augment in workers, native-queue prefetch
         feed = image_set.to_feed(batch_size=args.batch_size,
-                                 num_workers=args.num_workers)
+                                 num_workers=args.num_workers,
+                                 workers=args.feed_backend,
+                                 readahead=args.readahead)
         est.fit(feed, epochs=args.epochs, batch_size=args.batch_size)
 
         eval_set = ImageSet.read(data_dir, with_label=True).transform(

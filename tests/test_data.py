@@ -94,6 +94,50 @@ class TestReaders:
         with pytest.raises(FileNotFoundError):
             read_csv(str(tmp_path / "none*.csv"))
 
+    def test_extension_matching_is_case_insensitive(self, tmp_path):
+        """.CSV / .JPG-style uppercase extensions were silently dropped
+        from directory reads (ISSUE 7 satellite)."""
+        _df(6, 0).to_csv(tmp_path / "lower.csv", index=False)
+        _df(4, 1).to_csv(tmp_path / "UPPER.CSV", index=False)
+        s = read_csv(str(tmp_path))
+        assert s.num_partitions() == 2
+        assert len(s) == 10
+
+    def test_file_readahead_overlaps_and_counts_waits(self, tmp_path):
+        from analytics_zoo_tpu.data import FileReadahead
+        paths = []
+        for i in range(4):
+            p = tmp_path / f"f{i}.bin"
+            p.write_bytes(bytes([i]) * 64)
+            paths.append(str(p))
+        ra = FileReadahead(depth=2)
+        ra.hint(paths)
+        import time
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not ra._cache:
+            time.sleep(0.005)
+        for i, p in enumerate(paths):
+            assert ra.get(p) == bytes([i]) * 64
+        # un-hinted miss reads inline and counts the blocked time
+        miss = tmp_path / "miss.bin"
+        miss.write_bytes(b"z" * 8)
+        before = ra.wait_ms
+        assert ra.get(str(miss)) == b"z" * 8
+        assert ra.wait_ms >= before
+        # a lost race must RETIRE the hint: no consumed path may linger
+        # in (or later enter) the cache, or depth such entries would
+        # park the reader forever
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with ra._cond:
+                stale = set(ra._cache) & set(paths)
+                idle = ra._reading is None and not ra._want
+            if idle and not stale:
+                break
+            time.sleep(0.005)
+        assert not stale, stale
+        ra.close()
+
 
 class TestDataFeed:
     def test_batches_are_sharded(self):
@@ -143,13 +187,23 @@ class TestDataFeed:
 
 class TestStreamingResilience:
     """Loader-failure policies: bounded retries, skip-and-count, visible
-    degradation counters (data/stream.py)."""
+    degradation counters (data/stream.py) — the SAME suite runs against
+    both decode backends (ISSUE 7: ``workers="process"`` must pass the
+    ordering/resilience/fault-injection contracts unchanged)."""
+
+    @pytest.fixture(params=["thread", "process"])
+    def backend(self, request):
+        if request.param == "process":
+            from analytics_zoo_tpu.data import shm_pool
+            if not shm_pool.available():
+                pytest.skip("process backend unavailable")
+        return request.param
 
     def _mesh(self):
         from analytics_zoo_tpu.core import init_orca_context
         return init_orca_context("local")
 
-    def test_transient_failure_retried_no_row_lost(self):
+    def test_transient_failure_retried_no_row_lost(self, backend):
         from analytics_zoo_tpu.data import StreamingDataFeed
         mesh = self._mesh()
         fails = {"n": 0}
@@ -161,14 +215,14 @@ class TestStreamingResilience:
             return {"x": np.full((2,), float(i), np.float32)}
 
         feed = StreamingDataFeed(8, flaky, batch_size=4, shuffle=False,
-                                 num_workers=1, retries=2)
+                                 num_workers=1, retries=2, workers=backend)
         rows = sorted(float(v) for b in feed.epoch(mesh, 0)
                       for v in np.asarray(b["x"])[:, 0])
         assert rows == [float(i) for i in range(8)]  # nothing lost
         assert feed.load_failures == 2
         assert feed.skipped_rows == 0
 
-    def test_persistent_failure_skipped_and_counted(self):
+    def test_persistent_failure_skipped_and_counted(self, backend):
         from analytics_zoo_tpu.data import StreamingDataFeed
         mesh = self._mesh()
 
@@ -178,7 +232,8 @@ class TestStreamingResilience:
             return {"x": np.full((2,), float(i), np.float32)}
 
         feed = StreamingDataFeed(8, corrupt, batch_size=4, shuffle=False,
-                                 num_workers=1, retries=1, on_error="skip")
+                                 num_workers=1, retries=1, on_error="skip",
+                                 workers=backend)
         rows = sorted(float(v) for b in feed.epoch(mesh, 0)
                       for v in np.asarray(b["x"])[:, 0])
         # row 3 was substituted with its neighbor: batch shape intact,
@@ -188,22 +243,22 @@ class TestStreamingResilience:
         assert feed.skipped_rows == 1
         assert feed.load_failures == 2  # initial try + 1 retry
 
-    def test_max_skipped_bounds_degradation(self):
+    def test_max_skipped_bounds_degradation(self, backend):
         from analytics_zoo_tpu.data import StreamingDataFeed
         mesh = self._mesh()
 
         def corrupt(i, rng=None):
-            if i % 2 == 0:
+            if i % 2 == 0 and i != 0:
                 raise OSError("corrupt sample")
             return {"x": np.full((2,), float(i), np.float32)}
 
         feed = StreamingDataFeed(8, corrupt, batch_size=4, shuffle=False,
                                  num_workers=1, on_error="skip",
-                                 max_skipped=1)
+                                 max_skipped=1, workers=backend)
         with pytest.raises(RuntimeError, match="max_skipped"):
             list(feed.epoch(mesh, 0))
 
-    def test_default_raise_policy_unchanged(self):
+    def test_default_raise_policy_unchanged(self, backend):
         from analytics_zoo_tpu.data import StreamingDataFeed
         mesh = self._mesh()
 
@@ -213,9 +268,32 @@ class TestStreamingResilience:
             return {"x": np.zeros((2,), np.float32)}
 
         feed = StreamingDataFeed(8, bad, batch_size=4, shuffle=False,
-                                 num_workers=2)
+                                 num_workers=2, workers=backend)
         with pytest.raises(ValueError, match="corrupt sample"):
             list(feed.epoch(mesh, 0))
+
+    def test_read_fail_injection_absorbed_from_workers(self, backend):
+        """The armed ``feed.read_fail`` point fires in the decode worker
+        (forked or threaded) and the parent registry's fired()/times
+        accounting stays coherent either way."""
+        from analytics_zoo_tpu.core import faults
+        from analytics_zoo_tpu.data import StreamingDataFeed
+        mesh = self._mesh()
+        reg = faults.get_registry()
+        feed = StreamingDataFeed(
+            8, lambda i, rng=None: {"x": np.full((2,), float(i),
+                                                 np.float32)},
+            batch_size=4, shuffle=False, num_workers=1, retries=1,
+            workers=backend)
+        before = reg.fired("feed.read_fail")
+        with reg.armed("feed.read_fail", times=1):
+            batches = list(feed.epoch(mesh, 0))
+        assert reg.fired("feed.read_fail") - before == 1
+        assert feed.load_failures == 1
+        assert feed.skipped_rows == 0
+        rows = sorted(float(v) for b in batches
+                      for v in np.asarray(b["x"])[:, 0])
+        assert rows == [float(i) for i in range(8)]
 
     def test_policy_validated(self):
         from analytics_zoo_tpu.data import StreamingDataFeed

@@ -80,6 +80,27 @@ def test_streaming_feed_matches_in_ram_feed(tmp_path):
         np.testing.assert_array_equal(g["y"], w["y"])
 
 
+def test_streaming_feed_with_readahead_matches_direct_reads(tmp_path):
+    """The per-worker FileReadahead path (io overlapped with decode) must
+    decode bit-identical batches to the direct-read path, and the feed
+    must surface the loader's io-wait through ``feed.io_wait_ms``."""
+    from analytics_zoo_tpu.core import metrics
+    root = _write_dataset(tmp_path / "imgs")
+    init_orca_context("local")
+    mesh = get_mesh()
+    iset = ImageSet.read(root).transform(ImageResize(16, 16),
+                                         ImageNormalize())
+    direct = iset.to_feed(batch_size=8, shuffle=False, num_workers=1)
+    got_direct = [np.asarray(b["x"]) for b in direct.epoch(mesh, 0)]
+    metrics.get_registry().reset()
+    ahead = iset.to_feed(batch_size=8, shuffle=False, num_workers=1,
+                         readahead=4)
+    got_ahead = [np.asarray(b["x"]) for b in ahead.epoch(mesh, 0)]
+    for a, b in zip(got_direct, got_ahead):
+        np.testing.assert_array_equal(a, b)
+    assert iset.readahead == 0  # to_feed(readahead=) must not mutate iset
+
+
 def test_streaming_feed_multiworker_covers_epoch(tmp_path):
     root = _write_dataset(tmp_path / "imgs")
     init_orca_context("local")
