@@ -44,6 +44,49 @@ class MeshConfig:
 
     AXIS_ORDER = ("data", "fsdp", "seq", "pipe", "model", "expert")
 
+    #: sharding-strategy names that resolve to a mesh layout via
+    #: :meth:`for_strategy` — the Estimator-facing vocabulary.
+    STRATEGIES = ("dp", "fsdp", "tp", "2d")
+
+    @classmethod
+    def for_strategy(cls, strategy: str, n_devices: Optional[int] = None,
+                     model: int = 2) -> "MeshConfig":
+        """Mesh layout for an Estimator sharding strategy by name — the
+        one-knob path from ``Estimator(sharding=...)`` vocabulary to a
+        concrete mesh, so scripts need not hand-pick axis sizes:
+
+        - ``"dp"``   → all devices on ``data`` (batch sharding only)
+        - ``"fsdp"`` → all devices on ``fsdp`` (ZeRO-3 batch+param axis)
+        - ``"tp"``   → all devices on ``model`` (pure tensor parallelism)
+        - ``"2d"``   → ``data × model``: ``model`` inner axis of size
+          ``model`` (default 2, the ICI-neighbor dimension), ``data``
+          absorbs the rest — the MLPerf-pod layout where the gradient
+          all-reduce rides ``data`` and sharded matmuls ride ``model``.
+
+        ``n_devices`` (when given) degrades gracefully: a ``2d`` request
+        whose ``model`` axis doesn't fit the device count falls back to
+        pure dp instead of erroring (with a warning), so the same script
+        runs on one chip and on a pod slice."""
+        name = strategy.replace(" ", "")
+        if name == "dp":
+            return cls(data=0)
+        if name == "fsdp":
+            return cls(data=1, fsdp=0)
+        if name == "tp":
+            return cls(data=1, model=0)
+        if name == "2d":
+            if n_devices is not None and (n_devices < 2 * model
+                                          or n_devices % model != 0):
+                import logging
+                logging.getLogger("analytics_zoo_tpu").warning(
+                    "mesh strategy '2d' wants a model axis of %d but only "
+                    "%d device(s) fit; degrading to pure data parallelism",
+                    model, n_devices or 0)
+                return cls(data=0)
+            return cls(data=0, model=model)
+        raise ValueError(f"unknown mesh strategy {strategy!r}; known: "
+                         f"{cls.STRATEGIES}")
+
     def resolved(self, n_devices: int) -> Dict[str, int]:
         """Return a concrete {axis: size} dict.
 
@@ -106,6 +149,13 @@ class ZooConfig:
     # device compute of step k.  0 = iterate the feed inline (the
     # pre-pipeline behavior, for bisection).
     prefetch: int = 2
+    # gradient-collective compression (orca/learn/estimator.py
+    # grad_compression=): None = feature off (today's implicit-psum path,
+    # zero overhead); "none" = uncompressed but metered
+    # (train.comm_ms/train.grad_bytes); "bf16"/"int8" = per-shard
+    # quantized all-reduce compiled into the train step (int8 carries
+    # error-feedback residuals in the train state).
+    grad_compression: Optional[str] = None
     # streaming input pipeline (data/stream.py): decode-worker backend —
     # "thread" (default; bisection-safe, byte-identical batches) or
     # "process" (multi-process decode writing into a shared-memory slot

@@ -140,19 +140,24 @@ def config_default(field: str, fallback: Any) -> Any:
     return fallback
 
 
-def make_mesh(mesh_shape: Optional[Dict[str, int] | MeshConfig] = None,
+def make_mesh(mesh_shape: Optional[str | Dict[str, int] | MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None,
               ) -> jax.sharding.Mesh:
     """Build a Mesh over the given devices.
 
-    ``mesh_shape`` is a MeshConfig or a {axis: size} dict (see MeshConfig);
-    one axis may be 0 to absorb the remaining devices.  Defaults to pure data
-    parallelism over all devices — the only parallelism the reference had
-    (SURVEY.md §2.9).
+    ``mesh_shape`` is a MeshConfig, a {axis: size} dict (see MeshConfig),
+    or a sharding-strategy name (``"dp"``/``"fsdp"``/``"tp"``/``"2d"`` —
+    ``MeshConfig.for_strategy``) so ``init_orca_context(mesh_shape="2d")``
+    builds the data × model layout without hand-picking axis sizes.  One
+    dict axis may be 0 to absorb the remaining devices.  Defaults to pure
+    data parallelism over all devices — the only parallelism the reference
+    had (SURVEY.md §2.9).
     """
     devices = list(devices if devices is not None else jax.devices())
     if isinstance(mesh_shape, MeshConfig):
         cfg = mesh_shape
+    elif isinstance(mesh_shape, str):
+        cfg = MeshConfig.for_strategy(mesh_shape, n_devices=len(devices))
     else:
         cfg = MeshConfig(**(mesh_shape or {"data": 0}))
     sizes = cfg.resolved(len(devices))
@@ -176,7 +181,8 @@ def make_mesh(mesh_shape: Optional[Dict[str, int] | MeshConfig] = None,
 
 
 def init_orca_context(cluster_mode: str = "local",
-                      mesh_shape: Optional[Dict[str, int]] = None,
+                      mesh_shape: Optional[str | Dict[str, int]
+                                           | MeshConfig] = None,
                       config: Optional[ZooConfig] = None,
                       coordinator_address: Optional[str] = None,
                       num_processes: Optional[int] = None,
@@ -203,8 +209,13 @@ def init_orca_context(cluster_mode: str = "local",
 
         cfg = config or ZooConfig()
         cfg.cluster_mode = cluster_mode
-        if mesh_shape:
-            cfg.mesh = MeshConfig(**mesh_shape)
+        if mesh_shape and not isinstance(mesh_shape, str):
+            # strategy STRINGS resolve later, after jax.distributed is up:
+            # len(jax.devices()) here would (a) initialize the local
+            # backend before distributed.initialize — which JAX forbids —
+            # and (b) size the mesh from one host's chips, not the pod's
+            cfg.mesh = (mesh_shape if isinstance(mesh_shape, MeshConfig)
+                        else MeshConfig(**mesh_shape))
         if coordinator_address:
             cfg.coordinator_address = coordinator_address
         if num_processes is not None:
@@ -259,6 +270,9 @@ def init_orca_context(cluster_mode: str = "local",
                                     cfg.heartbeat_interval)
             _HEARTBEAT.beat(force=True)
 
+        if isinstance(mesh_shape, str):  # now jax.devices() spans the pod
+            cfg.mesh = MeshConfig.for_strategy(
+                mesh_shape, n_devices=len(jax.devices()))
         _ZooContextMeta._mesh = make_mesh(cfg.mesh)
         _ZooContextMeta._config = cfg
         logger.info("initialized context: %d device(s), mesh %s",

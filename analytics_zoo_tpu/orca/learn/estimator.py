@@ -163,12 +163,41 @@ class ZooEstimator:
                  checkpoint_retries: int = 3,
                  nan_policy: Optional[str] = None,
                  nan_max_rollbacks: int = 3,
-                 augment: Any = None):
+                 augment: Any = None,
+                 grad_compression: Optional[str] = None):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
-        "fsdp" (ZeRO-3 over the ``fsdp`` axis), "tp+fsdp", or an explicit
-        list of parallel.ShardingRule.
+        "fsdp" (ZeRO-3 over the ``fsdp`` axis), "tp+fsdp", "2d" (the
+        data × model pod layout: batch sharded along ``data``, tp rules
+        along ``model`` — build the mesh with
+        ``init_orca_context(mesh_shape="2d")``), or an explicit list of
+        parallel.ShardingRule.  A strategy whose mesh axis is missing
+        trims to replication with a one-time WARNING (see
+        docs/distributed-training.md).
+
+        ``grad_compression``: wire width of the data-parallel gradient
+        all-reduce (EQuARX ladder, PAPERS.md) — the dominant communication
+        cost of scale-out training:
+
+        - ``None`` (default): feature off — today's implicit-psum step,
+          bit-for-bit unchanged, zero overhead.
+        - ``"none"``: uncompressed but METERED — the same step numerics
+          (bit-identical loss history, the bisection baseline) plus
+          ``train.comm_ms`` / ``train.grad_bytes`` telemetry.
+        - ``"bf16"``: each batch shard's gradient contribution rounds to
+          bfloat16 before the reduce (2 bytes/param on the wire, f32
+          accumulation).
+        - ``"int8"``: per-shard symmetric int8 quantization with
+          error-feedback residuals carried in the train state
+          (``ts["ef"]``, checkpointed) — 4× less collective traffic; safe
+          once past the first few warmup steps of very sharp loss
+          landscapes (see docs/distributed-training.md).
+
+        Compressed modes decompose the batch into one slice per mesh batch
+        shard inside the jit step (vmap) so each shard quantizes its OWN
+        contribution — the numerics of a real quantized collective.
+        Requires ``grad_accum=1``.
 
         ``frozen``: transfer-learning freeze (reference: GraphNet.freezeUpTo
         — SURVEY §2.3 Net loaders): a list of param-path prefixes
@@ -242,6 +271,24 @@ class ZooEstimator:
         self.nan_policy = nan_policy
         self.nan_max_rollbacks = max(0, nan_max_rollbacks)
         self.augment = augment
+        if grad_compression is None:
+            from analytics_zoo_tpu.core.context import config_default
+            grad_compression = config_default("grad_compression", None)
+        if grad_compression is not None:
+            from analytics_zoo_tpu.parallel.util import GRAD_COMPRESSION
+            if grad_compression not in GRAD_COMPRESSION:
+                raise ValueError(
+                    f"grad_compression must be one of {GRAD_COMPRESSION} "
+                    f"or None, got {grad_compression!r}")
+            if grad_compression != "none" and self.grad_accum > 1:
+                raise ValueError(
+                    "grad_compression='bf16'/'int8' requires grad_accum=1 "
+                    "(the compressed collective already decomposes the "
+                    "batch per shard)")
+        self.grad_compression = grad_compression
+        self._grad_bytes_step = 0   # analytic wire bytes per train step
+        self._comm_fn = None        # jitted all-reduce-only probe
+        self._warned_mesh = False
         self.bad_steps = 0       # total non-finite steps seen (host mirror)
         self._rollbacks = 0
         self._writer = (SummaryWriter(log_dir, app_name)
@@ -313,6 +360,7 @@ class ZooEstimator:
             lambda r, x: self.model.init(r, x, training=True)
         )(rng, example_x)
         self._wrap_frozen_tx(variables["params"])
+        self._warn_strategy_mesh_mismatch(mesh)
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
         if rules:
@@ -339,8 +387,64 @@ class ZooEstimator:
               # host mirror's value) — in ts so it checkpoints with step
               "bad_steps": jax.device_put(jnp.zeros((), jnp.int32),
                                           replicated)}
+        if self.grad_compression == "int8":
+            # error-feedback residuals: one [n_shards, ...] f32 tensor per
+            # param, dim 0 sharded over the batch axes so each mesh slice
+            # keeps ITS OWN quantization error — in ts so it checkpoints
+            # (and donates) with the rest of the train state
+            ts["ef"] = self._init_error_feedback(params, mesh)
         self._ts = ts
         self._build_steps(mesh)
+
+    def _init_error_feedback(self, params: Any, mesh) -> Any:
+        from analytics_zoo_tpu.parallel.util import (batch_shard_count,
+                                                     batch_shard_spec)
+        s = batch_shard_count(mesh)
+
+        def zero(p):
+            z = np.zeros((s,) + tuple(p.shape), np.float32)
+            return jax.device_put(z, NamedSharding(
+                mesh, batch_shard_spec(mesh, z.ndim)))
+
+        return jax.tree_util.tree_map(zero, params)
+
+    def _warn_strategy_mesh_mismatch(self, mesh) -> None:
+        """One-time heads-up when a named strategy asks for mesh axes the
+        current mesh does not have: the rules trim to replication (the
+        portable behavior), but silently training dp when the user asked
+        for "2d" is a debugging trap worth a WARNING."""
+        if self._warned_mesh or not isinstance(self.sharding, str):
+            return
+        self._warned_mesh = True
+        parts = set(self.sharding.replace(" ", "").split("+"))
+
+        def size(ax: str) -> int:
+            return mesh.shape[ax] if ax in mesh.axis_names else 1
+
+        missing = []
+        if parts & {"tp", "2d"} and size("model") <= 1:
+            missing.append("model")
+        if "fsdp" in parts and size("fsdp") <= 1:
+            missing.append("fsdp")
+        if "2d" in parts and size("data") <= 1:
+            missing.append("data")
+        if missing:
+            # remediation hint: a dict covering EVERY missing axis, with
+            # one wildcard batch axis so it spans any device count (a bare
+            # strategy name would be wrong for composites like "tp+fsdp"
+            # and circular when for_strategy already degraded a "2d" mesh
+            # that couldn't fit this device count)
+            hint = {"fsdp": 0} if "fsdp" in missing else {"data": 0}
+            if "model" in missing:
+                hint["model"] = 2
+            logger.warning(
+                "sharding=%r but the mesh has no sized %s axis (mesh %s): "
+                "affected rules trim to replication and training proceeds "
+                "data-parallel.  Build the mesh with init_orca_context("
+                "mesh_shape=%r) to get the requested layout (needs a "
+                "device count the fixed axes divide).",
+                self.sharding, "/".join(missing),
+                dict(zip(mesh.axis_names, mesh.devices.shape)), hint)
 
     def _build_steps(self, mesh) -> None:
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
@@ -351,9 +455,16 @@ class ZooEstimator:
         guard_skip = self.nan_policy == "skip_step"
         guard_host = self.nan_policy in ("warn", "rollback", "raise")
         aug = self.augment
+        comp = self.grad_compression
+        compress_wire = comp in ("bf16", "int8")
+        if compress_wire:
+            from analytics_zoo_tpu.parallel.util import (
+                batch_shard_count, batch_shard_spec, compressed_allreduce)
+            nshards = batch_shard_count(mesh)
 
         def train_step(ts, batch):
             step_rng = jax.random.fold_in(ts["rng"], ts["step"])
+            new_ef = None
 
             def lossf(params, xb, yb, state, rng):
                 if aug is not None:
@@ -398,6 +509,46 @@ class ZooEstimator:
                     micro)
                 grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
                 loss_val = losses.mean()
+            elif compress_wire:
+                # quantized gradient collective (EQuARX ladder): split the
+                # global batch into one slice per mesh batch shard, vmap
+                # per-shard forward/backward, then reduce the per-shard
+                # gradients through the compressed wire — each shard
+                # quantizes its OWN contribution (with its own scale and,
+                # for int8, its own error-feedback residual), exactly as a
+                # quantized AllReduce would on hardware.  XLA turns the
+                # trailing sum-over-shards into the actual collective.
+                b = _first_leaf(batch["x"]).shape[0]
+                if b % nshards:
+                    raise ValueError(
+                        f"global batch {b} is not divisible into the "
+                        f"mesh's {nshards} batch shard(s); "
+                        "grad_compression needs equal per-shard slices")
+
+                def stack(l):
+                    l = l.reshape((nshards, l.shape[0] // nshards)
+                                  + l.shape[1:])
+                    return jax.lax.with_sharding_constraint(
+                        l, NamedSharding(mesh,
+                                         batch_shard_spec(mesh, l.ndim)))
+
+                micro = jax.tree_util.tree_map(stack, batch)
+
+                def shard_grads(mb, rng):
+                    (loss, st), g = jax.value_and_grad(
+                        lossf, has_aux=True)(ts["params"], mb["x"],
+                                             mb["y"], ts["state"], rng)
+                    return loss, st, g
+
+                rngs = jax.vmap(lambda i: jax.random.fold_in(step_rng, i)
+                                )(jnp.arange(nshards))
+                shard_losses, states, gshards = jax.vmap(shard_grads)(
+                    micro, rngs)
+                grads, new_ef = compressed_allreduce(gshards, comp,
+                                                     ef=ts.get("ef"))
+                new_state = jax.tree_util.tree_map(_merge_shard_leaf,
+                                                   states)
+                loss_val = shard_losses.mean()
             else:
                 (loss_val, new_state), grads = jax.value_and_grad(
                     lossf, has_aux=True)(ts["params"], batch["x"],
@@ -423,6 +574,11 @@ class ZooEstimator:
                                                    ts["state"])
                 opt_state = jax.tree_util.tree_map(keep, opt_state,
                                                    ts["opt_state"])
+                if new_ef is not None:
+                    # a skipped step must not bank the bad step's
+                    # quantization error into the residual either
+                    new_ef = jax.tree_util.tree_map(keep, new_ef,
+                                                    ts["ef"])
                 bad_steps = bad_steps + jnp.where(ok, 0, 1).astype(jnp.int32)
             elif guard_host:
                 # host policies read only the loss — fold the gradient
@@ -435,6 +591,8 @@ class ZooEstimator:
             new_ts = {"params": params, "state": new_state,
                       "opt_state": opt_state, "step": ts["step"] + 1,
                       "rng": ts["rng"], "bad_steps": bad_steps}
+            if "ef" in ts:
+                new_ts["ef"] = new_ef if new_ef is not None else ts["ef"]
             return new_ts, loss_val
 
         def eval_step(ts, batch):
@@ -483,6 +641,49 @@ class ZooEstimator:
         self._multi_step_data = jax.jit(multi_step_data, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._pred_step = jax.jit(pred_step)
+        if comp is not None:
+            from analytics_zoo_tpu.parallel.util import grad_wire_bytes
+            self._grad_bytes_step = grad_wire_bytes(self._ts["params"],
+                                                    comp)
+            self._comm_fn = None  # probe (re)compiles against this mesh
+
+    def _measure_comm_ms(self) -> Optional[float]:
+        """Wall time of the gradient all-reduce ALONE at the configured
+        wire width (``train.comm_ms``): a jitted program that materializes
+        a gradient-shaped ``[n_shards, ...]`` payload and reduces it
+        through the exact ``compressed_allreduce`` the train step
+        compiles.  The payload is filled from a runtime scalar INSIDE the
+        program — nothing params-sized stays resident between epochs, and
+        a constant input can't let XLA fold the reduce away.  Run once per
+        epoch — a dispatch, not a profiler; the compile call is warmed and
+        discarded.  Comparing the series across ``grad_compression``
+        settings is the measurable collective win (the identical fill cost
+        cancels in the comparison)."""
+        if self.grad_compression is None or self._ts is None:
+            return None
+        from analytics_zoo_tpu.parallel.util import (batch_shard_count,
+                                                     batch_shard_spec,
+                                                     compressed_allreduce)
+        mesh = get_mesh()
+        comp = self.grad_compression
+        if self._comm_fn is None:
+            s = batch_shard_count(mesh)
+            shapes = [tuple(p.shape) for p in
+                      jax.tree_util.tree_leaves(self._ts["params"])]
+
+            def probe(t):
+                tree = [jax.lax.with_sharding_constraint(
+                    jnp.full((s,) + shp, t, jnp.float32),
+                    NamedSharding(mesh,
+                                  batch_shard_spec(mesh, 1 + len(shp))))
+                    for shp in shapes]
+                return compressed_allreduce(tree, comp)[0]
+
+            self._comm_fn = jax.jit(probe)
+            jax.block_until_ready(self._comm_fn(0.0))  # compile, discard
+        t0 = time.monotonic()
+        jax.block_until_ready(self._comm_fn(0.0))
+        return (time.monotonic() - t0) * 1000.0
 
     # -- training -------------------------------------------------------------
 
@@ -546,6 +747,12 @@ class ZooEstimator:
         m_samples = reg.counter("train.samples")
         m_bad = reg.counter("train.bad_steps")
         m_prefetch = reg.gauge("train.prefetch_depth")
+        # scale-out telemetry (docs/distributed-training.md): analytic
+        # wire bytes of the gradient collective per step, and a per-epoch
+        # all-reduce-only probe — both zero-cost unless grad_compression
+        # is configured (incl. "none", the metered uncompressed baseline)
+        m_comm = reg.histogram("train.comm_ms")
+        m_grad_bytes = reg.counter("train.grad_bytes")
 
         if self._preempt is not None:
             self._preempt.active = True
@@ -634,6 +841,8 @@ class ZooEstimator:
                             (time.monotonic() - t_fetch) * 1000.0)
                         m_steps.inc()
                         m_samples.inc(feed.global_batch)
+                        if self._grad_bytes_step:
+                            m_grad_bytes.inc(self._grad_bytes_step)
                         if host_nan_check and not math.isfinite(
                                 float(loss_val)):
                             self.bad_steps += 1
@@ -702,6 +911,9 @@ class ZooEstimator:
                         self.bad_steps - bad_before)
                 dt = time.monotonic() - t0
                 n = len(losses) * feed.global_batch
+                comm_ms = self._measure_comm_ms()  # None unless configured
+                if comm_ms is not None:
+                    m_comm.observe(comm_ms)
                 # epoch-granularity telemetry mirror: the same numbers
                 # land in the registry (histograms above) AND the
                 # SummaryWriter scalars, so both snapshot() and
@@ -963,8 +1175,33 @@ class ZooEstimator:
                     "bad_steps": jax.device_put(
                         jnp.asarray(tree.get("bad_steps", 0), jnp.int32),
                         replicated)}
+        if self.grad_compression == "int8":
+            self._ts["ef"] = self._restore_error_feedback(
+                tree.get("ef"), params, mesh)
         if self._train_step is None:
             self._build_steps(mesh)
+
+    def _restore_error_feedback(self, saved: Any, params: Any, mesh) -> Any:
+        """Checkpointed error-feedback residuals, re-placed under the
+        batch-shard layout; zeros when the checkpoint predates int8
+        compression or was written on a mesh with a different shard count
+        (the residual is a convergence aid, not required state)."""
+        from analytics_zoo_tpu.parallel.util import (batch_shard_count,
+                                                     batch_shard_spec)
+        s = batch_shard_count(mesh)
+        if saved is not None:
+            first = _first_leaf(saved)
+            if (jax.tree_util.tree_structure(saved)
+                    == jax.tree_util.tree_structure(params)
+                    and np.ndim(first) >= 1 and first.shape[0] == s):
+                return jax.tree_util.tree_map(
+                    lambda l: l if isinstance(l, jax.Array)
+                    else jax.device_put(np.asarray(l), NamedSharding(
+                        mesh, batch_shard_spec(mesh, np.ndim(l)))), saved)
+            logger.warning(
+                "checkpointed error-feedback residuals do not match the "
+                "current mesh (%d batch shards); resetting to zero", s)
+        return self._init_error_feedback(params, mesh)
 
     def get_train_summary(self, tag: str = "loss"):
         """[(step, value)] scalars from the configured log_dir (reference:
@@ -990,6 +1227,16 @@ class ZooEstimator:
 
 def _first_leaf(tree: Any) -> jax.Array:
     return jax.tree_util.tree_leaves(tree)[0]
+
+
+def _merge_shard_leaf(l: jax.Array) -> jax.Array:
+    """Per-shard model state ``[n_shards, ...]`` → one state tree: mean
+    for float leaves (BatchNorm running stats — the local-BN convention
+    every dp framework uses), shard 0 for integer/flag leaves (they are
+    shard-invariant)."""
+    if jnp.issubdtype(l.dtype, jnp.inexact):
+        return l.mean(0)
+    return l[0]
 
 
 def _supports_host_epoch(feed: Any) -> bool:
@@ -1111,7 +1358,12 @@ def _ensure_on_mesh(tree: Any, mesh) -> Any:
 
 
 def _resolve_sharding_rules(sharding: Any):
-    """"dp" → None; "tp"/"fsdp"/"tp+fsdp" → rule presets; list → as-is."""
+    """"dp" → None; "tp"/"fsdp"/"tp+fsdp"/"2d" → rule presets; list →
+    as-is.  "2d" resolves to the tensor-parallel rules — the data half of
+    the 2D layout is batch sharding, which every strategy gets from the
+    feed; the distinction from "tp" is the MESH (data × model, built by
+    ``init_orca_context(mesh_shape="2d")``) and the stronger intent check
+    in ``_warn_strategy_mesh_mismatch``."""
     if sharding is None or sharding == "dp":
         return None
     if isinstance(sharding, str):
@@ -1119,10 +1371,10 @@ def _resolve_sharding_rules(sharding: Any):
                                                 tensor_parallel_rules)
         rules = []
         parts = set(sharding.replace(" ", "").split("+"))
-        unknown = parts - {"tp", "fsdp", "dp"}
+        unknown = parts - {"tp", "fsdp", "dp", "2d"}
         if unknown:
             raise ValueError(f"unknown sharding strategy {sharding!r}")
-        if "tp" in parts:
+        if parts & {"tp", "2d"}:
             # composed tp+fsdp: the non-tp dim of each tp kernel goes to fsdp
             rules += tensor_parallel_rules(
                 fsdp_axis="fsdp" if "fsdp" in parts else None)

@@ -11,8 +11,11 @@ plain float, an optax schedule callable, or a dict spec, e.g.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Union
+import re
+from typing import Any, Optional, Sequence, Union
 
+import jax
+import jax.numpy as jnp
 import optax
 
 _SCHEDULES = {
@@ -53,6 +56,145 @@ def resolve_learning_rate(learning_rate: Any) -> Any:
     return _SCHEDULES[name](lr, **spec)
 
 
+# -- large-batch optimizers (LARS / LAMB) -------------------------------------
+#
+# The MLPerf-on-TPU-pods recipe (PAPERS.md "Scale MLPerf-0.6 models on
+# Google TPU-v3 Pods"): 2D-sharded scale-out only pays off if the big
+# global batch it enables still converges, and plain SGD/Adam do not past
+# ~8k.  LARS (You et al. 2017) and LAMB (You et al. 2019) fix that with a
+# LAYERWISE trust ratio — each parameter tensor's update is rescaled by
+# ||w|| / ||update|| so no layer's weights move disproportionately to
+# their magnitude — with bias/normalization parameters EXCLUDED from both
+# the ratio and weight decay (their norms are tiny and unregularized by
+# convention; adapting them destabilizes training).  Implemented natively
+# so the exclusion lists match this repo's nn parameter naming and the
+# trust-ratio math stays unit-testable.
+
+#: Parameter paths excluded from trust-ratio adaptation and weight decay:
+#: regexes searched against the "/"-joined param path (same convention as
+#: ``parallel.ShardingRule``).  Defaults cover nn/layers.py naming —
+#: Dense/Conv ``bias``, Layer/BatchNorm ``gamma``/``beta``.
+EXCLUDE_DEFAULT = (r"(^|/)bias$", r"(^|/)gamma$", r"(^|/)beta$")
+
+
+def _exclusion_tree(params: Any, exclude: Sequence[str]) -> Any:
+    """Pytree of python bools (static at trace time): True = this leaf is
+    excluded from trust-ratio scaling and weight decay."""
+    pats = [re.compile(p) for p in (exclude or ())]
+
+    def flag(path_entries, _leaf) -> bool:
+        from analytics_zoo_tpu.parallel.sharding import _key_str
+        path = "/".join(_key_str(k) for k in path_entries)
+        return any(p.search(path) for p in pats)
+
+    return jax.tree_util.tree_map_with_path(flag, params)
+
+
+def _norm(x: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def _trust_ratio(w_norm: jax.Array, u_norm: jax.Array,
+                 coefficient: float) -> jax.Array:
+    """``coefficient * ||w|| / ||u||`` guarded to 1 when either norm is 0
+    (a freshly-zero-initialized tensor must still receive its first
+    update, and a zero update must not produce NaN)."""
+    ok = (w_norm > 0) & (u_norm > 0)
+    return jnp.where(ok, coefficient * w_norm /
+                     jnp.where(ok, u_norm, 1.0), 1.0)
+
+
+def _lr_at(learning_rate: Any, count: jax.Array) -> jax.Array:
+    return (learning_rate(count) if callable(learning_rate)
+            else jnp.asarray(learning_rate, jnp.float32))
+
+
+def lars(learning_rate: Any, momentum: float = 0.9,
+         weight_decay: float = 1e-4, trust_coefficient: float = 0.001,
+         eps: float = 1e-9, nesterov: bool = False,
+         exclude: Sequence[str] = EXCLUDE_DEFAULT
+         ) -> optax.GradientTransformation:
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017) — SGD+momentum
+    whose per-layer step is ``trust_coefficient * ||w|| / (||g + wd*w||)``.
+    Excluded leaves (bias/norm by default) get plain momentum SGD."""
+
+    def init(params):
+        return {"momentum": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros_like(p, jnp.float32), params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("lars needs params (trust ratio reads ||w||)")
+        count = state["count"] + 1
+        lr = _lr_at(learning_rate, count)
+        excluded = _exclusion_tree(params, exclude)
+
+        def one(excl, g, p, m):
+            g = g.astype(jnp.float32)
+            if not excl:
+                g = g + weight_decay * p.astype(jnp.float32)
+                g = _trust_ratio(_norm(p), _norm(g) + eps,
+                                 trust_coefficient) * g
+            m = momentum * m + g
+            step = (momentum * m + g) if nesterov else m
+            return (-lr * step).astype(p.dtype), m
+
+        pairs = jax.tree_util.tree_map(one, excluded, grads, params,
+                                       state["momentum"])
+        outer = jax.tree_util.tree_structure(grads)
+        updates, new_m = jax.tree_util.tree_transpose(
+            outer, jax.tree_util.tree_structure((0, 0)), pairs)
+        return updates, {"momentum": new_m, "count": count}
+
+    return optax.GradientTransformation(init, update)
+
+
+def lamb(learning_rate: Any, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-6, weight_decay: float = 0.01,
+         trust_coefficient: float = 1.0,
+         exclude: Sequence[str] = EXCLUDE_DEFAULT
+         ) -> optax.GradientTransformation:
+    """LAMB (You et al. 2019): Adam moments, decoupled weight decay, and a
+    per-layer trust ratio ``||w|| / ||m̂/(√v̂+eps) + wd*w||``.  Excluded
+    leaves (bias/norm) skip both the ratio and the decay — plain Adam."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)  # noqa: E731
+        return {"mu": jax.tree_util.tree_map(zeros, params),
+                "nu": jax.tree_util.tree_map(zeros, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("lamb needs params (trust ratio reads ||w||)")
+        count = state["count"] + 1
+        lr = _lr_at(learning_rate, count)
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** c
+        bc2 = 1.0 - b2 ** c
+        excluded = _exclusion_tree(params, exclude)
+
+        def one(excl, g, p, mu, nu):
+            g = g.astype(jnp.float32)
+            mu = b1 * mu + (1.0 - b1) * g
+            nu = b2 * nu + (1.0 - b2) * jnp.square(g)
+            u = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+            if not excl:
+                u = u + weight_decay * p.astype(jnp.float32)
+                u = _trust_ratio(_norm(p), _norm(u), trust_coefficient) * u
+            return (-lr * u).astype(p.dtype), mu, nu
+
+        triples = jax.tree_util.tree_map(one, excluded, grads, params,
+                                         state["mu"], state["nu"])
+        outer = jax.tree_util.tree_structure(grads)
+        updates, new_mu, new_nu = jax.tree_util.tree_transpose(
+            outer, jax.tree_util.tree_structure((0, 0, 0)), triples)
+        return updates, {"mu": new_mu, "nu": new_nu, "count": count}
+
+    return optax.GradientTransformation(init, update)
+
+
 _FACTORIES = {
     "sgd": lambda lr, **kw: optax.sgd(lr, **kw),
     "momentum": lambda lr, **kw: optax.sgd(lr, momentum=kw.pop("momentum", 0.9),
@@ -61,8 +203,8 @@ _FACTORIES = {
     "adamw": lambda lr, **kw: optax.adamw(lr, **kw),
     "rmsprop": lambda lr, **kw: optax.rmsprop(lr, **kw),
     "adagrad": lambda lr, **kw: optax.adagrad(lr, **kw),
-    "lamb": lambda lr, **kw: optax.lamb(lr, **kw),
-    "lars": lambda lr, **kw: optax.lars(lr, **kw),
+    "lamb": lambda lr, **kw: lamb(lr, **kw),
+    "lars": lambda lr, **kw: lars(lr, **kw),
 }
 
 

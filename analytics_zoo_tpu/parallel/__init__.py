@@ -20,10 +20,14 @@ from .sharding import (ShardingRule, infer_param_specs, shard_variables,
 from .ring_attention import ring_attention, ring_self_attention
 from .moe import MoE
 from .pipeline import pipeline_apply, stacked_stage_init
+from .util import (GRAD_COMPRESSION, batch_shard_count, batch_shard_spec,
+                   compressed_allreduce, grad_wire_bytes, quantize_int8)
 
 __all__ = [
     "ShardingRule", "infer_param_specs", "shard_variables",
     "tensor_parallel_rules", "fsdp_rules",
     "ring_attention", "ring_self_attention",
     "MoE", "pipeline_apply", "stacked_stage_init",
+    "GRAD_COMPRESSION", "batch_shard_count", "batch_shard_spec",
+    "compressed_allreduce", "grad_wire_bytes", "quantize_int8",
 ]

@@ -17,12 +17,83 @@ Conventions the default rules rely on (see nn/layers.py, nn/attention.py):
 
 from __future__ import annotations
 
+import logging
 import re
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.core import metrics as _telemetry
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: (path, dim, axes) combinations already warned about — a rule that
+#: mismatches a tensor fires once per site, not once per step/leaf.
+_FALLBACK_WARNED: set = set()
+_FALLBACK_LOCK = threading.Lock()
+
+
+def _reset_fallback_warnings() -> None:
+    """Test hook: re-arm the one-time replication-fallback warnings."""
+    with _FALLBACK_LOCK:
+        _FALLBACK_WARNED.clear()
+
+
+def _note_fallback(path: Optional[str], dim: int, axes: Tuple[str, ...],
+                   shape: Sequence[int], size: int, reason: str) -> None:
+    """A rule wanted dim ``dim`` sharded over ``axes`` but the tensor can't
+    carry it: count every occurrence (``train.sharding_fallbacks``), warn
+    once per site.  Spec inference runs on the host BEFORE jit, so the
+    fallback is always a placement decision, never an in-jit error."""
+    _telemetry.get_registry().counter("train.sharding_fallbacks").inc()
+    key = (path, dim, axes)
+    with _FALLBACK_LOCK:
+        if key in _FALLBACK_WARNED:
+            return
+        _FALLBACK_WARNED.add(key)
+    logger.warning(
+        "sharding rule for %s: dim %d of shape %s %s mesh axes %s "
+        "(size %d) — falling back to replication for that dim",
+        path or "<unnamed param>", dim, tuple(shape), reason, axes, size)
+
+
+def _trim_spec_to_mesh(spec: P, mesh: Mesh, shape: Sequence[int],
+                       path: Optional[str] = None) -> P:
+    """Drop axis names not in the mesh / dims that don't divide; keeps the
+    rules portable across mesh shapes (e.g. model=1 ⇒ fully replicated).
+
+    Silent when the mesh simply lacks the axis (that is the portability
+    contract); a WARNING + ``train.sharding_fallbacks`` count when the axis
+    IS there but the tensor dim does not divide it (or the spec is longer
+    than the tensor rank) — that is a rule/model mismatch the user should
+    see, healed by replicating the dim instead of erroring."""
+    out = []
+    for i, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (
+            (entry,) if entry else ())
+        kept = tuple(n for n in names
+                     if n in mesh.axis_names and mesh.shape[n] > 1)
+        size = 1
+        for n in kept:
+            size *= mesh.shape[n]
+        if size <= 1:  # axis absent or size 1: portable no-op, stay quiet
+            out.append(None)
+        elif i >= len(shape):
+            _note_fallback(path, i, kept, shape, size,
+                           "has no such dim for")
+            out.append(None)
+        elif shape[i] % size != 0:
+            _note_fallback(path, i, kept, shape, size,
+                           "does not divide")
+            out.append(None)
+        else:
+            out.append(kept if len(kept) > 1 else kept[0])
+    while out and out[-1] is None:  # canonical form: P(None, None) == P()
+        out.pop()
+    return P(*out)
 
 
 @dataclass
@@ -34,28 +105,6 @@ class ShardingRule:
 
     def matches(self, path: str) -> bool:
         return re.search(self.pattern, path) is not None
-
-
-def _trim_spec_to_mesh(spec: P, mesh: Mesh, shape: Sequence[int]) -> P:
-    """Drop axis names not in the mesh / dims that don't divide; keeps the
-    rules portable across mesh shapes (e.g. model=1 ⇒ fully replicated)."""
-    out = []
-    for i, entry in enumerate(spec):
-        names = entry if isinstance(entry, tuple) else (
-            (entry,) if entry else ())
-        kept = tuple(n for n in names
-                     if n in mesh.axis_names and mesh.shape[n] > 1)
-        size = 1
-        for n in kept:
-            size *= mesh.shape[n]
-        if i < len(shape) and size > 1 and shape[i] % size == 0:
-            out.append(kept if len(kept) > 1 else
-                       (kept[0] if kept else None))
-        else:
-            out.append(None)
-    while out and out[-1] is None:  # canonical form: P(None, None) == P()
-        out.pop()
-    return P(*out)
 
 
 def tensor_parallel_rules(axis: str = "model",
@@ -98,7 +147,8 @@ def infer_param_specs(params: Any, rules: Sequence[ShardingRule],
         path = "/".join(_key_str(k) for k in path_entries)
         for rule in rules:
             if rule.matches(path):
-                return _trim_spec_to_mesh(rule.spec, mesh, leaf.shape)
+                return _trim_spec_to_mesh(rule.spec, mesh, leaf.shape,
+                                          path=path)
         return P()
 
     specs = {jax.tree_util.keystr(p): spec_for(p, l) for p, l in flat}
