@@ -187,6 +187,23 @@ class ZooConfig:
     log_dir: str = "/tmp/analytics_zoo_tpu"
     log_level: str = "INFO"
 
+    # request tracing (core/trace.py): slow-request WARNING threshold in
+    # ms and span-ring capacity.  None keeps the module defaults
+    # (trace.DEFAULT_SLOW_MS / trace.DEFAULT_MAX_RECORDS); applied by
+    # init_orca_context via trace.configure().
+    trace_slow_ms: Optional[float] = None
+    trace_ring: Optional[int] = None
+    # flight recorder (core/flightrec.py): directory for
+    # flightrec_<pid>.json crash dumps.  None (default) disables
+    # dumping; the ZOO_FLIGHTREC_DIR env var (set by the zoo-launch
+    # supervisor next to --metrics-dir) is the fallback.
+    flightrec_dir: Optional[str] = None
+    # step profiler (orca/learn/estimator.py Estimator(profile=)): the
+    # per-device peak FLOP/s the train.mfu gauge divides by.  None falls
+    # back to a nominal per-platform constant — set this to your
+    # hardware's real peak for an honest MFU.
+    device_peak_flops: Optional[float] = None
+
     # worker liveness (core/launcher.py gang supervision): a file this
     # process touches at init and then on training progress, so a
     # supervisor can tell a hung worker from a slow one.  ``None`` falls

@@ -256,6 +256,21 @@ def init_orca_context(cluster_mode: str = "local",
             logger.warning("fault injection armed from config: %s",
                            sorted(cfg.faults))
 
+        # telemetry knobs (core/trace.py + core/flightrec.py): the
+        # slow-request threshold and span-ring capacity were
+        # module-attribute-only; the config file is now the one place a
+        # deployment tunes them.  The flight recorder arms when a dump
+        # directory is configured (or the supervisor exported one).
+        if cfg.trace_slow_ms is not None or cfg.trace_ring is not None:
+            from . import trace as trace_lib
+            trace_lib.configure(slow_ms=cfg.trace_slow_ms,
+                                max_records=cfg.trace_ring)
+        if cfg.flightrec_dir or os.environ.get("ZOO_FLIGHTREC_DIR"):
+            from . import flightrec
+            if cfg.flightrec_dir:
+                flightrec.configure(cfg.flightrec_dir)
+            flightrec.install_signal_dump()
+
         # supervisor liveness contract (core/launcher.py): touch the
         # heartbeat file now — "import + init finished" is the first beat —
         # then let the training loop beat on progress

@@ -62,6 +62,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -69,6 +70,11 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 #: Exit code when the supervisor aborts on a diagnosed crash loop.
 EXIT_CRASH_LOOP = 86
+
+#: Size-based rotation threshold for the supervisor's jsonl files
+#: (``metrics_w<rank>.jsonl`` → ``.jsonl.1``): a long-running gang must
+#: not grow its telemetry files without bound.
+METRICS_ROTATE_BYTES = 4 * 1024 * 1024
 
 
 def _free_port() -> int:
@@ -138,21 +144,121 @@ def _read_heartbeat_payload(path: Optional[str]) -> dict:
         return {}
 
 
+def _fold_gang_snapshots(by_rank_attempt: Dict[Tuple[int, int], dict]
+                         ) -> dict:
+    """Fold per-(rank, attempt) registry snapshots into ONE gang-level
+    snapshot via ``MetricsRegistry.merge``.
+
+    The (rank, attempt) granularity is the restart-correctness seam:
+    a restarted rank's registry starts back at zero, so
+
+    - **counters/histograms** from EVERY attempt merge (sum /
+      bucket-add) — each attempt counted disjoint events, so the fold
+      is the rank's true lifetime total, and taking a max instead
+      (the tempting "latest wins" shortcut) would silently lose every
+      pre-restart event — the max-vs-sum confusion the tests pin down;
+    - **gauge values** are point-in-time state: a dead attempt's queue
+      depth is not load anymore, so gauges from non-latest attempts
+      contribute only their high-water ``max`` (value zeroed before
+      the merge)."""
+    from .metrics import MetricsRegistry
+    latest_attempt: Dict[int, int] = {}
+    for (rank, attempt) in by_rank_attempt:
+        latest_attempt[rank] = max(latest_attempt.get(rank, -1), attempt)
+    snaps = []
+    for (rank, attempt), snap in sorted(by_rank_attempt.items()):
+        if attempt != latest_attempt[rank]:
+            snap = {
+                series: (dict(val, value=0.0)
+                         if isinstance(val, dict) and "value" in val
+                         and "count" not in val else val)
+                for series, val in snap.items()}
+        snaps.append(snap)
+    return MetricsRegistry.merge(snaps)
+
+
+def aggregate_worker_metrics(metrics_dir: str) -> dict:
+    """Offline gang aggregation: fold the per-worker
+    ``metrics_w<rank>.jsonl`` files (current + ``.1`` rotation) under
+    ``metrics_dir`` into one gang-level snapshot.  Tolerant by design:
+    empty files, torn trailing lines (a worker died mid-write) and
+    ranks that never beat simply contribute nothing.  Only lines
+    carrying a ``metrics`` registry snapshot participate; the LATEST
+    such line per (rank, attempt) wins, and attempts fold per
+    ``_fold_gang_snapshots`` (counters sum across restarts — no
+    double-count, no lost history)."""
+    import glob
+    import re
+    by_ra: Dict[Tuple[int, int], dict] = {}
+    paths = []
+    for path in glob.glob(os.path.join(metrics_dir,
+                                       "metrics_w*.jsonl*")):
+        m = re.search(r"metrics_w(\d+)\.jsonl(\.1)?$", path)
+        if m:
+            # rotated ``.1`` generation FIRST, current file second: for
+            # the same (rank, attempt) the current file's newer snapshot
+            # must win the latest-line-wins fold, and a plain sorted()
+            # would process ".jsonl" before ".jsonl.1"
+            paths.append((int(m.group(1)), 0 if m.group(2) else 1, path))
+    for rank, _, path in sorted(paths):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write from a dying worker
+            snap = rec.get("metrics")
+            if not isinstance(snap, dict):
+                continue
+            by_ra[(rank, int(rec.get("attempt", 0)))] = snap
+    return _fold_gang_snapshots(by_ra)
+
+
 class _GangStatus:
     """Periodic gang-status aggregation: every ``interval`` seconds the
     supervisor reads each worker's heartbeat JSON payload, logs ONE
     line summarizing the whole gang (step/loss/samples-per-sec per
     rank) and, when ``metrics_dir`` is set, appends each worker's
-    payload to ``metrics_w<rank>.jsonl`` there — the training-side
-    trajectory file the observability docs describe."""
+    payload to ``metrics_w<rank>.jsonl`` there (size-rotated to
+    ``.jsonl.1``) — the training-side trajectory file the
+    observability docs describe.
+
+    Workers launched with metrics aggregation embed their full
+    registry snapshot in epoch-end heartbeat payloads
+    (``ZOO_HEARTBEAT_METRICS``); this class folds the latest snapshot
+    per (rank, attempt) into ONE gang-level snapshot
+    (``gang_snapshot()``), appends it to ``gang_metrics.jsonl`` and —
+    with ``--metrics-port`` — serves it as a Prometheus scrape."""
 
     def __init__(self, interval: Optional[float],
-                 metrics_dir: Optional[str]):
+                 metrics_dir: Optional[str],
+                 rotate_bytes: int = METRICS_ROTATE_BYTES):
         self.interval = interval
         self.metrics_dir = metrics_dir
+        self.rotate_bytes = rotate_bytes
         self._last = time.monotonic()
+        self._gang: Dict[Tuple[int, int], dict] = {}
+        self._gang_lock = threading.Lock()
         if metrics_dir is not None:
             os.makedirs(metrics_dir, exist_ok=True)
+
+    def gang_snapshot(self) -> dict:
+        """The current gang-level merged snapshot (see
+        ``_fold_gang_snapshots`` for the restart semantics)."""
+        with self._gang_lock:
+            by_ra = dict(self._gang)
+        return _fold_gang_snapshots(by_ra)
+
+    def gang_prometheus(self) -> str:
+        """The gang snapshot as Prometheus text — what ``--metrics-port``
+        serves."""
+        from .metrics import MetricsRegistry
+        return MetricsRegistry.from_snapshot(
+            self.gang_snapshot()).prometheus()
 
     def maybe_emit(self, procs: List[subprocess.Popen],
                    hb_files: List[Optional[str]], attempt: int,
@@ -166,7 +272,9 @@ class _GangStatus:
         if not force and now - self._last < self.interval:
             return
         self._last = now
+        from .metrics import append_jsonl_rotating
         parts = []
+        saw_registry = False
         for rank, hb in enumerate(hb_files):
             payload = _read_heartbeat_payload(hb)
             alive = procs[rank].poll() is None
@@ -179,17 +287,77 @@ class _GangStatus:
                     bits.append(f"{key}={v:.4g}"
                                 if isinstance(v, float) else f"{key}={v}")
             parts.append("[" + " ".join(bits) + "]")
+            if isinstance(payload.get("metrics"), dict):
+                saw_registry = True
+                with self._gang_lock:
+                    self._gang[(rank, attempt)] = payload["metrics"]
             if self.metrics_dir is not None and payload:
                 rec = dict(payload, rank=rank, attempt=attempt)
                 try:
-                    with open(os.path.join(
-                            self.metrics_dir,
-                            f"metrics_w{rank}.jsonl"), "a") as f:
-                        f.write(json.dumps(rec) + "\n")
+                    append_jsonl_rotating(
+                        os.path.join(self.metrics_dir,
+                                     f"metrics_w{rank}.jsonl"),
+                        json.dumps(rec), self.rotate_bytes)
                 except OSError:
                     pass  # telemetry must never kill supervision
+        if saw_registry and self.metrics_dir is not None:
+            try:
+                append_jsonl_rotating(
+                    os.path.join(self.metrics_dir, "gang_metrics.jsonl"),
+                    json.dumps({"wall": time.time(), "attempt": attempt,
+                                "metrics": self.gang_snapshot()}),
+                    self.rotate_bytes)
+            except OSError:
+                pass
         logger.info("gang status (attempt %d): %s", attempt,
                     " ".join(parts))
+
+
+class _GangMetricsServer:
+    """``--metrics-port``: a tiny HTTP endpoint on the SUPERVISOR
+    serving the merged gang snapshot — ``GET /metrics`` (Prometheus
+    text) and ``GET /metrics.json`` (the raw merged snapshot) — so one
+    scrape covers the whole gang without reaching into any worker."""
+
+    def __init__(self, port: int, status: _GangStatus):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        gang = status
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("gang-metrics http: " + fmt, *args)
+
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/metrics.json"):
+                        body = json.dumps(gang.gang_snapshot()).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/metrics"):
+                        body = gang.gang_prometheus().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass  # scraper went away mid-reply
+
+        self._httpd = HTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="zoo-gang-metrics")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
 
 
 def _supervise(procs: List[subprocess.Popen], hb_files: List[Optional[str]],
@@ -244,6 +412,8 @@ def launch(script: str, script_args: List[str], nprocs: int,
            crash_loop_threshold: int = 3,
            metrics_dir: Optional[str] = None,
            status_interval: Optional[float] = 10.0,
+           metrics_port: Optional[int] = None,
+           metrics_rotate_bytes: int = METRICS_ROTATE_BYTES,
            on_event: Optional[Callable[[str, dict], None]] = None) -> int:
     """Run a gang of ``nprocs`` local processes under supervision.
 
@@ -267,7 +437,15 @@ def launch(script: str, script_args: List[str], nprocs: int,
     samples/sec — written by ``core.heartbeat(**status)``) every
     ``status_interval`` seconds, logs one gang-status line, and — when
     ``metrics_dir`` is given — appends each worker's payload to
-    ``<metrics_dir>/metrics_w<rank>.jsonl`` (docs/observability.md).
+    ``<metrics_dir>/metrics_w<rank>.jsonl`` (size-rotated at
+    ``metrics_rotate_bytes``; docs/observability.md).  With
+    ``metrics_dir`` set, workers also embed full registry snapshots in
+    their epoch-end heartbeats (``ZOO_HEARTBEAT_METRICS``) which the
+    supervisor folds into one GANG-level snapshot —
+    ``<metrics_dir>/gang_metrics.jsonl`` plus, with ``metrics_port``, a
+    Prometheus ``GET /metrics`` endpoint on the supervisor — and
+    exports ``ZOO_FLIGHTREC_DIR=<metrics_dir>`` so workers dump flight
+    records there when the gang is torn down.
     """
     emit = on_event or (lambda kind, info: None)
     hb_dir = heartbeat_dir
@@ -280,7 +458,8 @@ def launch(script: str, script_args: List[str], nprocs: int,
             platform, timeout, max_restarts, backoff, backoff_factor,
             max_backoff, heartbeat_timeout, heartbeat_interval, hb_dir,
             grace, poll_interval, crash_loop_threshold, emit,
-            metrics_dir, status_interval)
+            metrics_dir, status_interval, metrics_port,
+            metrics_rotate_bytes)
     finally:
         if own_hb_dir:
             import shutil
@@ -292,8 +471,34 @@ def _launch_supervised(script, script_args, nprocs, devices_per_proc,
                        backoff, backoff_factor, max_backoff,
                        heartbeat_timeout, heartbeat_interval, hb_dir,
                        grace, poll_interval, crash_loop_threshold,
-                       emit, metrics_dir=None, status_interval=None) -> int:
-    status = _GangStatus(status_interval, metrics_dir)
+                       emit, metrics_dir=None, status_interval=None,
+                       metrics_port=None,
+                       metrics_rotate_bytes=METRICS_ROTATE_BYTES) -> int:
+    status = _GangStatus(status_interval, metrics_dir,
+                         rotate_bytes=metrics_rotate_bytes)
+    metrics_server = None
+    if metrics_port is not None:
+        metrics_server = _GangMetricsServer(metrics_port, status)
+        logger.info("gang metrics endpoint on 127.0.0.1:%d/metrics",
+                    metrics_server.port)
+    try:
+        return _run_attempts(
+            script, script_args, nprocs, devices_per_proc, coordinator,
+            platform, timeout, max_restarts, backoff, backoff_factor,
+            max_backoff, heartbeat_timeout, heartbeat_interval, hb_dir,
+            grace, poll_interval, crash_loop_threshold, emit,
+            metrics_dir, status)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
+
+
+def _run_attempts(script, script_args, nprocs, devices_per_proc,
+                  coordinator, platform, timeout, max_restarts,
+                  backoff, backoff_factor, max_backoff,
+                  heartbeat_timeout, heartbeat_interval, hb_dir,
+                  grace, poll_interval, crash_loop_threshold,
+                  emit, metrics_dir, status) -> int:
     attempt = 0
     first_fail_counts: Dict[int, int] = {}
     while True:
@@ -307,6 +512,13 @@ def _launch_supervised(script, script_args, nprocs, devices_per_proc,
             # forever waiting for the missing gang members
             for pid in range(nprocs):
                 extra = {"ZOO_RESTART_COUNT": str(attempt)}
+                if metrics_dir is not None:
+                    # metrics aggregation is on: have workers embed
+                    # registry snapshots in epoch-end heartbeats (the
+                    # gang fold's input) and dump flight records into
+                    # the same directory when the gang is torn down
+                    extra["ZOO_HEARTBEAT_METRICS"] = "1"
+                    extra["ZOO_FLIGHTREC_DIR"] = metrics_dir
                 hb: Optional[str] = None
                 if hb_dir is not None:
                     hb = os.path.join(hb_dir, f"hb_a{attempt}_w{pid}")
@@ -406,10 +618,17 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "fails this many times" % EXIT_CRASH_LOOP)
     parser.add_argument("--metrics-dir", default=None,
                         help="append each worker's heartbeat status "
-                             "payload to metrics_w<rank>.jsonl here")
+                             "payload to metrics_w<rank>.jsonl here "
+                             "(size-rotated), fold worker registry "
+                             "snapshots into gang_metrics.jsonl, and "
+                             "collect worker flight-recorder dumps")
     parser.add_argument("--status-interval", type=float, default=10.0,
                         help="seconds between gang-status log lines "
                              "(heartbeat payload aggregation)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve the merged gang-level snapshot as "
+                             "Prometheus text on this supervisor port "
+                             "(GET /metrics; 0 = any free port)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -430,7 +649,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         heartbeat_interval=args.heartbeat_interval,
         crash_loop_threshold=args.crash_loop_threshold,
         metrics_dir=args.metrics_dir,
-        status_interval=args.status_interval))
+        status_interval=args.status_interval,
+        metrics_port=args.metrics_port))
 
 
 if __name__ == "__main__":

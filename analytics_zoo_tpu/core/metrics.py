@@ -68,11 +68,47 @@ def _series_name(name: str, labels: _LabelKey) -> str:
     return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
 
 
+def _parse_series(series: str) -> Tuple[str, _LabelKey]:
+    """Inverse of ``_series_name``: ``"a.b{k=v,j=w}"`` → name + sorted
+    label key.  Metric names never contain ``{``, and label values in
+    this framework never contain ``,``/``=`` (routes, replica addresses,
+    point names), so the split is unambiguous."""
+    if "{" not in series:
+        return series, ()
+    name, _, body = series.partition("{")
+    pairs = []
+    for part in body.rstrip("}").split(","):
+        k, _, v = part.partition("=")
+        pairs.append((k, v))
+    return name, tuple(sorted(pairs))
+
+
+def _bucket_percentile(edges: Tuple[float, ...], counts: List[int],
+                       q: float) -> float:
+    """q-quantile by linear interpolation within the winning bucket —
+    the shared math behind ``Histogram.percentile`` and merged-snapshot
+    summaries."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if seen + c >= target and c > 0:
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[i] if i < len(edges) else edges[-1]
+            frac = (target - seen) / c
+            return lo + frac * (hi - lo)
+        seen += c
+    return edges[-1]
+
+
 class Counter:
     """Monotonic counter.  ``inc()`` only goes up; ``reset()`` (via the
     registry) zeroes it for test isolation."""
 
-    __slots__ = ("name", "labels", "_lock", "value", "_registry")
+    __slots__ = ("name", "labels", "_lock", "value", "_registry",
+                 "_pinned")
 
     def __init__(self, name: str, labels: _LabelKey, registry:
                  "MetricsRegistry"):
@@ -81,6 +117,7 @@ class Counter:
         self._lock = threading.Lock()
         self.value = 0
         self._registry = registry
+        self._pinned = False
 
     def inc(self, value: float = 1) -> None:
         if not self._registry.enabled:
@@ -104,7 +141,8 @@ class Gauge:
     """Point-in-time value with a high-water mark (``max``) — queue
     depths, in-flight request counts.  ``add()`` for up/down deltas."""
 
-    __slots__ = ("name", "labels", "_lock", "value", "max", "_registry")
+    __slots__ = ("name", "labels", "_lock", "value", "max", "_registry",
+                 "_pinned")
 
     def __init__(self, name: str, labels: _LabelKey,
                  registry: "MetricsRegistry"):
@@ -114,6 +152,7 @@ class Gauge:
         self.value = 0.0
         self.max = 0.0
         self._registry = registry
+        self._pinned = False
 
     def set(self, value: float) -> None:
         if not self._registry.enabled:
@@ -149,7 +188,7 @@ class Histogram:
     per-observation allocation."""
 
     __slots__ = ("name", "labels", "edges", "_lock", "counts", "sum",
-                 "count", "_registry")
+                 "count", "_registry", "_pinned")
 
     def __init__(self, name: str, labels: _LabelKey,
                  registry: "MetricsRegistry",
@@ -165,6 +204,7 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
         self._registry = registry
+        self._pinned = False
 
     def observe(self, value: float) -> None:
         if not self._registry.enabled:
@@ -184,20 +224,8 @@ class Histogram:
     def percentile(self, q: float) -> float:
         """Approximate q-quantile (q in [0, 1]) from bucket counts."""
         with self._lock:
-            total = self.count
             counts = list(self.counts)
-        if total == 0:
-            return 0.0
-        target = q * total
-        seen = 0
-        for i, c in enumerate(counts):
-            if seen + c >= target and c > 0:
-                lo = self.edges[i - 1] if i > 0 else 0.0
-                hi = self.edges[i] if i < len(self.edges) else self.edges[-1]
-                frac = (target - seen) / c
-                return lo + frac * (hi - lo)
-            seen += c
-        return self.edges[-1]
+        return _bucket_percentile(self.edges, counts, q)
 
     def _reset(self) -> None:
         with self._lock:
@@ -206,12 +234,20 @@ class Histogram:
             self.count = 0
 
     def _snapshot(self) -> Any:
+        # bucket edges + counts ride along so cross-process snapshots can
+        # be MERGED exactly (``MetricsRegistry.merge`` bucket-adds them);
+        # the summary keys keep their pre-merge meaning for readers
         with self._lock:
             count, total = self.count, self.sum
+            counts = list(self.counts)
         return {"count": count, "sum": round(total, 6),
                 "mean": round(total / count, 6) if count else 0.0,
-                "p50": round(self.percentile(0.50), 6),
-                "p99": round(self.percentile(0.99), 6)}
+                "p50": round(_bucket_percentile(self.edges, counts,
+                                                0.50), 6),
+                "p99": round(_bucket_percentile(self.edges, counts,
+                                                0.99), 6),
+                "bucket_edges": list(self.edges),
+                "bucket_counts": counts}
 
 
 class _HistogramTimer:
@@ -248,7 +284,8 @@ class MetricsRegistry:
 
     # -- handle creation ------------------------------------------------------
 
-    def _get(self, cls, name: str, labels: Dict[str, Any], **kw: Any):
+    def _get(self, cls, name: str, labels: Dict[str, Any],
+             pin: bool = True, **kw: Any):
         key = (name, _label_key(labels))
         with self._lock:
             # type uniqueness is per NAME, not per (name, labels): the
@@ -265,6 +302,12 @@ class MetricsRegistry:
                 m = cls(name, key[1], self, **kw)
                 self._metrics[key] = m
                 self._types[name] = cls
+            if pin:
+                # a caller holding a handle expects the series to survive
+                # reset() (zeroed in place); one-shot writes (pin=False)
+                # create EPHEMERAL series reset() retires entirely — see
+                # reset()'s docstring for why the distinction matters
+                m._pinned = True
             return m
 
     def counter(self, name: str, **labels: Any) -> Counter:
@@ -295,15 +338,16 @@ class MetricsRegistry:
     # -- one-shot writes ------------------------------------------------------
 
     def inc(self, name: str, value: float = 1, **labels: Any) -> None:
-        self.counter(name, **labels).inc(value)
+        self._get(Counter, name, labels, pin=False).inc(value)
 
     def set_gauge(self, name: str, value: float, **labels: Any) -> None:
-        self.gauge(name, **labels).set(value)
+        self._get(Gauge, name, labels, pin=False).set(value)
 
     def observe(self, name: str, value: float,
                 buckets: Optional[Tuple[float, ...]] = None,
                 **labels: Any) -> None:
-        self.histogram(name, buckets=buckets, **labels).observe(value)
+        self._get(Histogram, name, labels, pin=False,
+                  buckets=buckets).observe(value)
 
     # -- reads ----------------------------------------------------------------
 
@@ -381,23 +425,167 @@ class MetricsRegistry:
                                  f"{count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def export_jsonl(self, path: str) -> None:
+    def export_jsonl(self, path: str,
+                     max_bytes: Optional[int] = None) -> None:
         """Append one ``{"wall": ..., "metrics": snapshot()}`` line —
-        the trajectory-file format ``metrics.jsonl`` readers parse."""
+        the trajectory-file format ``metrics.jsonl`` readers parse.
+
+        ``max_bytes``: size-based rotation — when the file already
+        exceeds it, the file is renamed to ``<path>.1`` (replacing the
+        previous generation) before the append, so a long-running
+        exporter holds at most ~2×``max_bytes`` on disk while readers
+        keep a full recent window."""
         rec = {"wall": time.time(), "metrics": self.snapshot()}
-        with open(path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        append_jsonl_rotating(path, json.dumps(rec), max_bytes)
 
     # -- lifecycle ------------------------------------------------------------
 
     def reset(self) -> None:
-        """Zero every series IN PLACE: handles cached by long-lived
-        components (a running server's counters) stay registered and
-        valid; only the values clear.  Test-boundary hygiene."""
+        """Zero every HANDLE-HELD series in place and retire the rest.
+
+        Series created through the handle API (``counter()`` /
+        ``gauge()`` / ``histogram()``) stay registered and zeroed, so
+        handles cached by long-lived components (a running server's
+        counters) keep working across test boundaries.  Series created
+        only by one-shot writes (``inc``/``observe``/``set_gauge`` —
+        e.g. a label value minted per event) are REMOVED: leaving them
+        zeroed made a reset registry's exposition differ from a fresh
+        registry's under identical traffic (zero-valued label series the
+        fresh registry never saw), which is exactly the dangling-series
+        bug tests tripped over with pre-created handles."""
         with self._lock:
-            metrics = list(self._metrics.values())
+            keep = {}
+            for key, m in self._metrics.items():
+                if m._pinned:
+                    keep[key] = m
+            self._metrics = keep
+            live_names = {k[0] for k in keep}
+            self._types = {n: t for n, t in self._types.items()
+                           if n in live_names}
+            metrics = list(keep.values())
         for m in metrics:
             m._reset()
+
+    # -- cross-process aggregation -------------------------------------------
+
+    @staticmethod
+    def merge(snapshots: List[Dict[str, Any]],
+              drop_labels: Tuple[str, ...] = ()) -> Dict[str, Any]:
+        """Fold N ``snapshot()`` dicts (from N processes / replicas /
+        gang workers) into one cluster-level snapshot:
+
+        - **counters** sum (each process counted disjoint events);
+        - **gauges** sum their current values (cluster queue depth is
+          the sum of per-replica depths) and **max-merge** their
+          high-water marks;
+        - **histograms** bucket-add (exact when bucket edges agree —
+          they do for same-version processes; on an edge mismatch the
+          buckets are dropped and only count/sum/mean merge), with
+          p50/p99 recomputed from the merged buckets.
+
+        ``drop_labels`` removes those label keys before merging, so a
+        cluster view folds ``client.request_ms{replica=...}`` series
+        into one unlabeled distribution."""
+        out: Dict[str, Any] = {}
+        for snap in snapshots:
+            for series, val in snap.items():
+                name, labels = _parse_series(series)
+                if drop_labels:
+                    labels = tuple((k, v) for k, v in labels
+                                   if k not in drop_labels)
+                key = _series_name(name, labels)
+                cur = out.get(key)
+                if cur is None:
+                    out[key] = (dict(val) if isinstance(val, dict)
+                                else val)
+                elif isinstance(val, dict) and "count" in val:
+                    _merge_hist(cur, val)
+                elif isinstance(val, dict):
+                    cur["value"] = cur.get("value", 0) + val.get("value",
+                                                                0)
+                    cur["max"] = max(cur.get("max", 0), val.get("max", 0))
+                else:
+                    out[key] = cur + val
+        for val in out.values():
+            if isinstance(val, dict) and "bucket_counts" in val:
+                edges = tuple(val["bucket_edges"])
+                counts = val["bucket_counts"]
+                val["mean"] = (round(val["sum"] / val["count"], 6)
+                               if val["count"] else 0.0)
+                val["p50"] = round(_bucket_percentile(edges, counts,
+                                                      0.50), 6)
+                val["p99"] = round(_bucket_percentile(edges, counts,
+                                                      0.99), 6)
+        return dict(sorted(out.items()))
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "MetricsRegistry":
+        """Materialize a registry from a ``snapshot()``-shaped dict (a
+        merged cluster view, a worker's exported jsonl line) so it can
+        be rendered with ``prometheus()`` or re-merged."""
+        reg = cls()
+        for series, val in snap.items():
+            name, labels = _parse_series(series)
+            kw = dict(labels)
+            if isinstance(val, dict) and "count" in val:
+                edges = tuple(val.get("bucket_edges")
+                              or LATENCY_BUCKETS_MS)
+                h = reg._get(Histogram, name, kw, buckets=edges)
+                counts = val.get("bucket_counts")
+                with h._lock:
+                    h.count = int(val["count"])
+                    h.sum = float(val["sum"])
+                    if counts is not None and len(counts) == len(
+                            h.counts):
+                        h.counts = [int(c) for c in counts]
+                    else:
+                        h.counts[-1] = int(val["count"])
+            elif isinstance(val, dict):
+                g = reg._get(Gauge, name, kw)
+                with g._lock:
+                    g.value = float(val.get("value", 0.0))
+                    g.max = float(val.get("max", 0.0))
+            else:
+                c = reg._get(Counter, name, kw)
+                with c._lock:
+                    c.value = val
+        return reg
+
+
+def _merge_hist(cur: Dict[str, Any], val: Dict[str, Any]) -> None:
+    """In-place histogram-summary merge (summaries recomputed by the
+    caller once every snapshot folded in)."""
+    cur["count"] = cur.get("count", 0) + val.get("count", 0)
+    cur["sum"] = round(cur.get("sum", 0.0) + val.get("sum", 0.0), 6)
+    ce, ve = cur.get("bucket_edges"), val.get("bucket_edges")
+    if ce is not None and ve is not None and list(ce) == list(ve):
+        cur["bucket_counts"] = [a + b for a, b in
+                                zip(cur["bucket_counts"],
+                                    val["bucket_counts"])]
+    else:
+        # edge mismatch (version skew): exact bucket math is impossible;
+        # drop the buckets so the merged summary never lies about p50/p99
+        cur.pop("bucket_edges", None)
+        cur.pop("bucket_counts", None)
+
+
+def append_jsonl_rotating(path: str, line: str,
+                          max_bytes: Optional[int] = None) -> None:
+    """Append one line to ``path`` with optional size-based rotation to
+    ``<path>.1`` — shared by ``export_jsonl`` and the zoo-launch
+    supervisor's ``metrics_w<rank>.jsonl`` writers.  Rotation happens
+    BEFORE the append (whole lines only, so readers keep their
+    torn-file tolerance and never see a line split across
+    generations)."""
+    import os
+    if max_bytes is not None:
+        try:
+            if os.path.getsize(path) >= max_bytes:
+                os.replace(path, path + ".1")
+        except OSError:
+            pass  # no file yet, or a racing rotation — append wins
+    with open(path, "a") as f:
+        f.write(line + "\n")
 
 
 def _prom_escape(v: str) -> str:

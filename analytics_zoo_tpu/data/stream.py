@@ -69,6 +69,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from analytics_zoo_tpu.core import metrics as metrics_lib
+from analytics_zoo_tpu.core import trace as trace_lib
 from analytics_zoo_tpu.core.context import config_default
 from analytics_zoo_tpu.native import NativeQueue
 from . import shm_pool
@@ -199,6 +200,39 @@ class StreamingDataFeed(FeedBase):
         self._m_io = reg.histogram("feed.io_wait_ms")
         self._m_shm = reg.gauge("feed.shm_in_use")
         self._m_h2d = reg.histogram("feed.h2d_ms")
+        # span tree (core/trace.py): one trace id per epoch; per-batch
+        # decode spans hang under the epoch root — the thread backend
+        # records them in the worker, the process backend forwards the
+        # timings over the existing control-message channel and the
+        # parent records them (children can't reach the parent's ring)
+        self.trace_id: Optional[str] = None
+        self._epoch_sid: Optional[str] = None
+
+    def _begin_epoch_trace(self, epoch_idx: int) -> None:
+        if trace_lib.enabled:
+            self.trace_id = trace_lib.new_trace_id()
+            self._epoch_sid = trace_lib.new_span_id()
+        else:
+            self.trace_id = self._epoch_sid = None
+
+    def _record_decode_span(self, step: int, decode_ms: float,
+                            io_ms: float) -> None:
+        if self.trace_id is not None:
+            trace_lib.record(
+                self.trace_id, "feed.decode",
+                {"step": step, "decode_ms": round(decode_ms, 3),
+                 "io_wait_ms": round(io_ms, 3)},
+                parent=self._epoch_sid, dur_ms=decode_ms)
+
+    def _end_epoch_trace(self, epoch_idx: int, steps: int,
+                         t0: float) -> None:
+        if self.trace_id is not None:
+            trace_lib.record(
+                self.trace_id, "feed.epoch",
+                {"epoch": epoch_idx, "steps": steps,
+                 "backend": self.workers},
+                span_id=self._epoch_sid,
+                dur_ms=(time.monotonic() - t0) * 1000.0)
 
     # -- resilient sample loading --------------------------------------------
 
@@ -441,6 +475,8 @@ class StreamingDataFeed(FeedBase):
                       ) -> Iterator[Dict[str, "np.ndarray"]]:
         idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
+        self._begin_epoch_trace(epoch_idx)
+        epoch_t0 = time.monotonic()
 
         # the bounded native queue carries batch tokens; ready holds the
         # actual arrays (at most prefetch_batches + num_workers entries,
@@ -471,11 +507,13 @@ class StreamingDataFeed(FeedBase):
                     rows = [self._load_row(int(i), rng) for i in sel]
                     batch = {k: np.stack([r[k] for r in rows])
                              for k in rows[0]}
-                    self._m_decode.observe(
-                        (time.monotonic() - t0) * 1000.0)
+                    decode_ms = (time.monotonic() - t0) * 1000.0
+                    self._m_decode.observe(decode_ms)
                     io_ms = self._io_wait_ms() - io0
                     if io_ms > 0:
                         self._m_io.observe(io_ms)
+                    self._record_decode_span(step, decode_ms,
+                                             max(0.0, io_ms))
                 except BaseException as e:          # noqa: BLE001 loader bug
                     with ready_cond:
                         errors.append(e)
@@ -513,6 +551,7 @@ class StreamingDataFeed(FeedBase):
                     # generator finalized during interpreter teardown:
                     # threading internals are already torn down
                     pass
+            self._end_epoch_trace(epoch_idx, steps, epoch_t0)
 
     # -- process backend ------------------------------------------------------
 
@@ -545,6 +584,8 @@ class StreamingDataFeed(FeedBase):
         ctx = mp.get_context("fork")
         idx = self._epoch_index(epoch_idx)
         steps = self.steps_per_epoch()
+        self._begin_epoch_trace(epoch_idx)
+        epoch_t0 = time.monotonic()
         spec = self._batch_spec(idx)
         nslots = max(2, self.prefetch_batches + self.num_workers)
         pool = ShmBatchPool(nslots, self._local_batch, spec, ctx=ctx)
@@ -612,6 +653,11 @@ class StreamingDataFeed(FeedBase):
                     self._m_load.observe(load_ms)  # per-sample batch mean
                     if io_ms > 0:
                         self._m_io.observe(io_ms)
+                    # forked workers can't reach this process's span
+                    # ring — the decode timing rode the control message,
+                    # so the span is recorded HERE, under the epoch root
+                    self._record_decode_span(step, decode_ms,
+                                             max(0.0, io_ms))
                     batch = SlotBatch(pool.views(slot), slot, pool)
                     with ready_cond:
                         ready[step] = batch
@@ -684,6 +730,7 @@ class StreamingDataFeed(FeedBase):
             self._active_pool = None
             pool.close()
             self._m_shm.set(0)
+            self._end_epoch_trace(epoch_idx, steps, epoch_t0)
 
 
 class _ProcShared:

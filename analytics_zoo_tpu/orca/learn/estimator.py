@@ -39,6 +39,7 @@ from analytics_zoo_tpu.core import get_mesh
 from analytics_zoo_tpu.core.config import ZooConfig
 from analytics_zoo_tpu.core import faults as faults_lib
 from analytics_zoo_tpu.core import metrics as telemetry
+from analytics_zoo_tpu.core import trace as trace_lib
 from analytics_zoo_tpu.core.context import heartbeat
 from analytics_zoo_tpu.core.summary import SummaryWriter
 from analytics_zoo_tpu.data import (PrefetchIterator, as_feed,
@@ -54,6 +55,23 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 #: Valid values for ``ZooEstimator(nan_policy=...)``.
 NAN_POLICIES = ("warn", "skip_step", "rollback", "raise")
+
+#: Nominal per-device peak FLOP/s by jax platform, the ``train.mfu``
+#: denominator when ``ZooConfig.device_peak_flops`` is unset.  These are
+#: order-of-magnitude placeholders (MFU is a trend signal either way);
+#: set the config field to your hardware's real peak for honest numbers.
+NOMINAL_PEAK_FLOPS = {"cpu": 5e10, "gpu": 1e13, "tpu": 9e13}
+
+
+def _jit_cache_size(fn: Any) -> Optional[int]:
+    """How many executables a jitted function has compiled so far —
+    the per-step compile-event probe (``InferenceModel.compile_count``'s
+    pattern applied to the training step).  None when this jax version
+    doesn't expose the cache."""
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — private API, degrade silently
+        return None
 
 
 class NonFiniteLossError(RuntimeError):
@@ -164,7 +182,8 @@ class ZooEstimator:
                  nan_policy: Optional[str] = None,
                  nan_max_rollbacks: int = 3,
                  augment: Any = None,
-                 grad_compression: Optional[str] = None):
+                 grad_compression: Optional[str] = None,
+                 profile: Any = None):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -247,7 +266,29 @@ class ZooEstimator:
         step's per-step rng (reproducible, scheduling-independent).
         Train steps run the chain with a fresh fold of the step rng;
         evaluate/predict run it deterministically (center crop, no flip,
-        normalize applies)."""
+        normalize applies).
+
+        ``profile``: the step profiler (ISSUE 9) — ``None`` (off, zero
+        overhead), ``True``, or a dict:
+
+        - **compile events**: every step that grew the train step's
+          executable cache (a retrace — new input shape/dtype, changed
+          static config) bumps ``train.compiles`` and records a
+          ``train.compile`` span, so "why was step 847 slow?" has an
+          answer (``InferenceModel.compile_count``'s pattern, applied
+          to training);
+        - **MFU**: for models that declare ``flops_per_sample`` (an
+          attribute, or the dict key) — the analytic per-sample
+          training FLOPs — each epoch sets the ``train.mfu`` gauge to
+          ``flops_per_sample × samples_per_sec / (peak × n_devices)``.
+          ``peak`` comes from the dict's ``peak_flops``, then
+          ``ZooConfig.device_peak_flops``, then a nominal per-platform
+          constant (``NOMINAL_PEAK_FLOPS``);
+        - **device trace**: dict keys ``trace_dir`` + ``trace_steps``
+          ``(k, k+n)`` capture a ``jax.profiler`` trace for steps
+          [k, k+n) — the same machinery as the ``profile_dir`` /
+          ``profile_steps`` constructor args, reachable from the one
+          ``profile=`` knob."""
         self.model = model
         self.loss_fn = losses_lib.get(loss)
         self.tx = opt_lib.get(optimizer, learning_rate, grad_clip_norm)
@@ -306,6 +347,19 @@ class ZooEstimator:
         self.profile_dir = profile_dir
         self.profile_steps = tuple(profile_steps)
         self._profiling = False
+        # step profiler (ISSUE 9): compile events + MFU; trace_dir /
+        # trace_steps in the dict ride the jax.profiler machinery above
+        self._profile_cfg: Optional[Dict[str, Any]] = None
+        if profile:
+            pcfg = {} if profile is True else dict(profile)
+            self._profile_cfg = {
+                "flops_per_sample": pcfg.get("flops_per_sample"),
+                "peak_flops": pcfg.get("peak_flops")}
+            if pcfg.get("trace_dir"):
+                self.profile_dir = pcfg["trace_dir"]
+                self.profile_steps = tuple(
+                    pcfg.get("trace_steps", self.profile_steps))
+        self.compile_count = 0  # train-step executables compiled (profile=)
         # preemption-safe training (core/failover.py): SIGTERM → consensus
         # checkpoint to model_dir → raise Preempted
         self._preempt = None
@@ -753,15 +807,39 @@ class ZooEstimator:
         # is configured (incl. "none", the metered uncompressed baseline)
         m_comm = reg.histogram("train.comm_ms")
         m_grad_bytes = reg.counter("train.grad_bytes")
+        # step profiler (profile=): compile events + the MFU gauge —
+        # handles exist only when the profiler is on, so the catalog
+        # guard and the zero-overhead default both hold
+        if self._profile_cfg is not None:
+            m_compiles = reg.counter("train.compiles")
+            m_mfu = reg.gauge("train.mfu")
+        cache_prev: Optional[int] = None
+        # span tree (core/trace.py): one trace per fit() — epochs under
+        # the fit root, steps under their epoch — so the training loop's
+        # step/data-wait phases land in the same causality substrate the
+        # serving path uses.  Gated with the metrics kill switch: the
+        # <5% overhead guard measures the fully-uninstrumented baseline.
+        record_spans = trace_lib.enabled and reg.enabled
+        fit_tid = trace_lib.new_trace_id() if record_spans else None
+        fit_sid = trace_lib.new_span_id() if record_spans else None
+        self.trace_id = fit_tid  # correlate this fit in the span ring
+        fit_t0 = time.monotonic()
 
         if self._preempt is not None:
             self._preempt.active = True
         ZooEstimator._device_lock.acquire()
         try:
             first = True
+            if (self._profile_cfg is not None
+                    and self._train_step is not None):
+                # resumed fit: baseline the executable cache so only NEW
+                # compiles in this fit count as compile events
+                cache_prev = _jit_cache_size(self._train_step)
             # while (not for): nan_policy="rollback" rewinds self._epoch to
             # the restored checkpoint's epoch and re-runs from there
             while self._epoch < target_epoch:
+                epoch_sid = (trace_lib.new_span_id() if record_spans
+                             else None)
                 # monotonic: a wall-clock step (NTP) mid-epoch must not
                 # produce negative or wildly wrong throughput numbers
                 t0 = time.monotonic()
@@ -812,6 +890,12 @@ class ZooEstimator:
                         if first:
                             self._ensure_initialized(batch["x"])
                             first = False
+                            if self._profile_cfg is not None:
+                                # freshly built steps: cache starts
+                                # empty, so the first step's compile IS
+                                # a counted event
+                                cache_prev = _jit_cache_size(
+                                    self._train_step) or 0
                         # liveness beat for the zoo-launch gang
                         # supervisor (no-op unless a heartbeat file is
                         # configured); the payload makes the heartbeat
@@ -837,8 +921,32 @@ class ZooEstimator:
                         # self._ts["step"] would force a device sync on
                         # every iteration
                         self._py_step += 1
-                        m_step.observe(
-                            (time.monotonic() - t_fetch) * 1000.0)
+                        if self._profile_cfg is not None:
+                            # compile-event probe: the executable cache
+                            # grew during THIS step ⇒ it paid a retrace
+                            # (new input shape/dtype) — name the step
+                            cs = _jit_cache_size(self._train_step)
+                            if (cs is not None and cache_prev is not None
+                                    and cs > cache_prev):
+                                self.compile_count += cs - cache_prev
+                                m_compiles.inc(cs - cache_prev)
+                                trace_lib.record(
+                                    fit_tid, "train.compile",
+                                    {"step": self._py_step,
+                                     "compiles": cs - cache_prev},
+                                    parent=epoch_sid)
+                            if cs is not None:
+                                cache_prev = cs
+                        step_ms_i = (time.monotonic() - t_fetch) * 1000.0
+                        m_step.observe(step_ms_i)
+                        if record_spans:
+                            trace_lib.record(
+                                fit_tid, "train.step",
+                                {"step": self._py_step,
+                                 "step_ms": round(step_ms_i, 3),
+                                 "data_wait_ms": round(wait * 1000.0,
+                                                       3)},
+                                parent=epoch_sid, dur_ms=step_ms_i)
                         m_steps.inc()
                         m_samples.inc(feed.global_batch)
                         if self._grad_bytes_step:
@@ -922,8 +1030,30 @@ class ZooEstimator:
                 wait_ms = 1000.0 * epoch_wait / len(losses)
                 compute_ms = max(0.0, step_ms - wait_ms)
                 samples_per_sec = n / dt
+                mfu = self._measure_mfu(samples_per_sec)
+                if mfu is not None:
+                    m_mfu.set(mfu)
+                if record_spans:
+                    trace_lib.record(
+                        fit_tid, "train.epoch",
+                        {"epoch": self._epoch,
+                         "loss": round(epoch_loss, 6),
+                         "steps": len(losses),
+                         "step_ms": round(step_ms, 3),
+                         "data_wait_ms": round(wait_ms, 3)},
+                        span_id=epoch_sid, parent=fit_sid,
+                        dur_ms=dt * 1000.0)
+                hb_extra = {}
+                if os.environ.get("ZOO_HEARTBEAT_METRICS"):
+                    # gang telemetry: the supervisor asked for full
+                    # registry snapshots in the heartbeat payload — it
+                    # folds every rank's latest into the gang-level
+                    # snapshot (metrics_w<rank>.jsonl → gang_metrics.
+                    # jsonl / --metrics-port, core/launcher.py)
+                    hb_extra["metrics"] = reg.snapshot()
                 heartbeat(force=True, step=self._py_step, loss=epoch_loss,
-                          samples_per_sec=round(samples_per_sec, 2))
+                          samples_per_sec=round(samples_per_sec, 2),
+                          **hb_extra)
                 if self._writer:
                     self._writer.add_scalar("loss", epoch_loss, self._epoch)
                     self._writer.add_scalar("throughput", n / dt,
@@ -954,11 +1084,55 @@ class ZooEstimator:
                         step=self._py_step, epoch_end=True):
                     self.save(self.model_dir)
             self._stop_profile()  # short runs: close the trace at fit end
+        except Exception as e:
+            # flight recorder: an unhandled step exception (including a
+            # terminal NonFiniteLossError) dumps the recent spans +
+            # metric movement + warnings next to the checkpoints, so
+            # the post-mortem starts with state, not guesses.
+            # ``Preempted`` is a BaseException precisely so intentional
+            # shutdown doesn't land here.
+            from analytics_zoo_tpu.core import flightrec
+            flightrec.dump(
+                f"train.{type(e).__name__}", dump_dir=self.model_dir,
+                extra={"step": self._py_step, "epoch": self._epoch,
+                       "error": str(e)})
+            raise
         finally:
             ZooEstimator._device_lock.release()
             if self._preempt is not None:
                 self._preempt.active = False
+            if record_spans:
+                trace_lib.record(
+                    fit_tid, "train.fit",
+                    {"epochs": self._epoch - start_epoch,
+                     "steps": self._py_step},
+                    span_id=fit_sid,
+                    dur_ms=(time.monotonic() - fit_t0) * 1000.0)
         return history
+
+    def _measure_mfu(self, samples_per_sec: float) -> Optional[float]:
+        """Analytic model-FLOPs utilization for the ``train.mfu`` gauge:
+        ``flops_per_sample × samples/sec / (peak_flops × n_devices)``.
+        None (gauge untouched) unless the profiler is on AND the model
+        declares ``flops_per_sample`` (or the profile dict supplies it).
+        The peak is ``profile['peak_flops']`` → ``ZooConfig.
+        device_peak_flops`` → a nominal per-platform constant — nominal
+        peaks make MFU a trend signal, not an absolute; configure the
+        real peak for honest numbers."""
+        if self._profile_cfg is None:
+            return None
+        fps = (self._profile_cfg.get("flops_per_sample")
+               or getattr(self.model, "flops_per_sample", None))
+        if not fps:
+            return None
+        peak = self._profile_cfg.get("peak_flops")
+        if peak is None:
+            from analytics_zoo_tpu.core.context import config_default
+            peak = config_default("device_peak_flops", None)
+        if peak is None:
+            peak = NOMINAL_PEAK_FLOPS.get(jax.default_backend(), 1e12)
+        return float(fps) * samples_per_sec / (float(peak)
+                                               * jax.device_count())
 
     def _rollback_to_checkpoint(self) -> None:
         """nan_policy="rollback": restore the latest ``model_dir``

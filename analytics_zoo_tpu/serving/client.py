@@ -119,9 +119,11 @@ class _Conn:
         self._results = {}
         self._inflight: Dict[str, bytes] = {}  # uuid -> encoded frame
         self._inflight_bytes = 0
-        # uuid -> (trace id, enqueue time.monotonic): the client half of
-        # the end-to-end trace (core/trace.py)
-        self._traces: Dict[str, Tuple[str, float]] = {}
+        # uuid -> (trace id, enqueue time.monotonic, client span id):
+        # the client half of the end-to-end trace (core/trace.py); the
+        # span id also rode the frame header so server-side stage spans
+        # parent under this attempt
+        self._traces: Dict[str, Tuple[str, float, Optional[str]]] = {}
         self._generation = 0  # bumped per successful (re)connect
         self._cond = threading.Condition()
         self._send_lock = threading.Lock()
@@ -293,7 +295,8 @@ class _Conn:
             self._inflight[uid] = frame
             self._inflight_bytes += len(frame)
             if header.get("trace") is not None:
-                self._traces[uid] = (header["trace"], time.monotonic())
+                self._traces[uid] = (header["trace"], time.monotonic(),
+                                     header.get("span"))
             while (len(self._inflight) > self.MAX_INFLIGHT
                    or self._inflight_bytes > self.MAX_INFLIGHT_BYTES):
                 evicted = next(iter(self._inflight))
@@ -385,10 +388,30 @@ class _Conn:
         with self._cond:
             return self._results.pop(uid, None)
 
-    def forget(self, uid: str) -> Optional[Tuple[str, float]]:
+    def metrics_snapshot(self, timeout: float = 2.0) -> Optional[Dict]:
+        """One telemetry-scrape round trip: the server's registry
+        ``snapshot()`` dict, or None when no reply arrives in
+        ``timeout``.  Like ``ping``, deliberately no retry and no
+        reconnect — the caller (a cluster-scope scrape) simply skips an
+        unreachable replica."""
+        uid = f"metrics-{uuid_mod.uuid4().hex[:12]}"
+        try:
+            with self._send_lock:
+                protocol.send_frame(self.sock,
+                                    protocol.encode_metrics_request(uid))
+        except (OSError, AttributeError):
+            return None
+        res = self.wait(uid, timeout)
+        if res is None:
+            return None
+        _, _err, header = res
+        return (header or {}).get("metrics")
+
+    def forget(self, uid: str
+               ) -> Optional[Tuple[str, float, Optional[str]]]:
         """Drop the resend record (request answered, or caller gave up).
-        Returns the (trace id, enqueue time) pair for the request, so
-        the caller can close out its trace."""
+        Returns the (trace id, enqueue time, client span id) triple for
+        the request, so the caller can close out its trace."""
         with self._cond:
             frame = self._inflight.pop(uid, None)
             if frame is not None:
@@ -448,6 +471,9 @@ class InputQueue:
         uid = uid or f"{name}-{uuid_mod.uuid4()}"
         header = protocol.request_header(
             uid, trace=trace_id or trace_lib.new_trace_id(),
+            # the client span id travels in the header so the server's
+            # stage spans parent under THIS attempt in trace.tree()
+            span=trace_lib.new_span_id() if trace_lib.enabled else None,
             model=model, version=version,
             deadline_ms=(max(1, int(deadline * 1000))
                          if deadline is not None else None))
@@ -524,15 +550,18 @@ class OutputQueue:
                     # total + the server's per-stage breakdown from the
                     # reply header (stamped by the inference worker that
                     # ran the batch: queue wait, batch assembly,
-                    # inference, realized batch size), one record, one
-                    # correlatable id
-                    tid, t0 = info
+                    # inference, realized batch size), one span, one
+                    # correlatable id.  The span id is the one that rode
+                    # the request header, so the server-side stage spans
+                    # already hang beneath this record in trace.tree().
+                    tid, t0, sid = info
                     total = (time.monotonic() - t0) * 1000.0
                     all_stages = {"client.total_ms": round(total, 3)}
                     if stages:
                         all_stages.update(stages)
                     conn._m_request.observe(total)
-                    trace_lib.record(tid, "client", all_stages)
+                    trace_lib.record(tid, "client", all_stages,
+                                     span_id=sid, dur_ms=total)
                     trace_lib.maybe_log_slow(tid, uid, total, all_stages)
                 return arr
             if (any(m in err for m in RETRYABLE_ERRORS)

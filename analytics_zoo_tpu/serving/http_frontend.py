@@ -164,27 +164,39 @@ class HTTPFrontend:
 
             def do_GET(self):
                 self._t0 = time.monotonic()
-                self._route = self.path if self.path in (
+                path, _, query = self.path.partition("?")
+                self._route = path if path in (
                     "/", "/health", "/healthz", "/stats",
                     "/metrics") else "other"
                 self._routed = False
                 try:
-                    if self.path in ("/", "/health"):
+                    if path in ("/", "/health"):
                         self._json(200, {"status": "ok"})
-                    elif self.path == "/healthz":
+                    elif path == "/healthz":
                         # own + per-replica health; 503 only when NO
                         # replica is routable, so load balancers pull a
                         # frontend whose whole backend set is down
                         hz = frontend.healthz()
                         self._json(200 if hz["status"] != "down" else 503,
                                    hz)
-                    elif self.path == "/stats":
+                    elif path == "/stats":
                         self._json(200, frontend.stats())
-                    elif self.path == "/metrics":
-                        # Prometheus scrape: the whole process registry,
-                        # so one scrape covers serving + client +
-                        # frontend (+ training, when co-located)
-                        self._text(200, frontend._metrics.prometheus(),
+                    elif path == "/metrics":
+                        # Prometheus scrape.  Default scope: the whole
+                        # LOCAL process registry (serving + client +
+                        # frontend + training when co-located).
+                        # ?scope=cluster scrapes every routable
+                        # replica's registry over the TCP metrics frame
+                        # and serves the MERGED view with replica=
+                        # labels dropped — one scrape for the whole
+                        # replica set, whichever processes it spans.
+                        from urllib.parse import parse_qs
+                        scope = parse_qs(query).get("scope", [""])[-1]
+                        if scope == "cluster":
+                            text = frontend.cluster_prometheus()
+                        else:
+                            text = frontend._metrics.prometheus()
+                        self._text(200, text,
                                    "text/plain; version=0.0.4; "
                                    "charset=utf-8")
                     else:
@@ -288,6 +300,19 @@ class HTTPFrontend:
         hz = self._router.healthz()
         hz["frontend"] = "ok"
         return hz
+
+    def cluster_metrics(self) -> dict:
+        """The merged cluster snapshot (``ReplicaSet.cluster_metrics``):
+        every routable replica's registry folded into one, ``replica=``
+        labels dropped."""
+        return self._router.cluster_metrics()
+
+    def cluster_prometheus(self) -> str:
+        """``GET /metrics?scope=cluster``: the merged cluster snapshot
+        rendered as Prometheus text exposition."""
+        merged = self.cluster_metrics()
+        return metrics_lib.MetricsRegistry.from_snapshot(
+            merged).prometheus()
 
     def stats(self) -> dict:
         """The ``/stats`` payload: namespaced ``frontend.*`` /

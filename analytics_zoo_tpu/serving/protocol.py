@@ -46,13 +46,27 @@ MAX_FRAME_BYTES = 256 * 1024 * 1024
 #: even though its socket still accepts writes.
 PING = "ping"
 
+#: Header ``type`` for a telemetry scrape.  A metrics frame is
+#: header-only; the server answers it straight from the connection loop
+#: with ``{"uuid": ..., "metrics": registry.snapshot()}`` — the TCP
+#: analog of the HTTP frontend's ``GET /metrics``, so a router (or the
+#: frontend's ``/metrics?scope=cluster``) can fold every replica's
+#: registry into one cluster view without each replica running HTTP.
+METRICS = "metrics"
+
 
 def encode_ping(uid: str) -> bytes:
     """A health-probe frame for ``uid`` (header-only, no tensor)."""
     return encode({"uuid": uid, "type": PING})
 
 
+def encode_metrics_request(uid: str) -> bytes:
+    """A telemetry-scrape frame for ``uid`` (header-only, no tensor)."""
+    return encode({"uuid": uid, "type": METRICS})
+
+
 def request_header(uid: str, trace: Optional[str] = None,
+                   span: Optional[str] = None,
                    model: Optional[str] = None,
                    version: Optional[str] = None,
                    deadline_ms: Optional[int] = None) -> Dict[str, Any]:
@@ -61,6 +75,10 @@ def request_header(uid: str, trace: Optional[str] = None,
     pre-multi-model client's frames are unchanged byte for byte:
 
     - ``trace``: end-to-end trace id (core/trace.py);
+    - ``span``: the SENDER's span id for this attempt — the parent the
+      server-side stage spans attach under, so ``trace.tree`` can hang
+      a hedged request's two server executions beneath their respective
+      client attempt spans;
     - ``model``: route to this named model in a multi-model server
       (``ClusterServing(models=...)``); absent = the server's default
       model;
@@ -72,6 +90,8 @@ def request_header(uid: str, trace: Optional[str] = None,
     header: Dict[str, Any] = {"uuid": uid}
     if trace is not None:
         header["trace"] = trace
+    if span is not None:
+        header["span"] = span
     if model is not None:
         header["model"] = str(model)
     if version is not None:

@@ -203,21 +203,34 @@ class Replica:
     def enqueue(self, uid: str, arr: np.ndarray,
                 deadline: Optional[float], trace_id: str,
                 model: Optional[str] = None,
-                version: Optional[str] = None) -> None:
+                version: Optional[str] = None,
+                parent_span: Optional[str] = None) -> None:
         """Send one request under an EXPLICIT uuid (failover and hedging
         re-enqueue the same uuid on another replica — the idempotency
         contract from PR 1, stretched across backends).  ``model`` /
         ``version`` route within a multi-model backend, exactly like
-        ``InputQueue.enqueue``."""
+        ``InputQueue.enqueue``.
+
+        ``parent_span``: the router's root span id — each enqueue mints
+        an ATTEMPT span id under it (riding the frame header so the
+        server's stage spans attach there); a hedged request's two
+        replica attempts thereby become sibling spans under one root."""
+        sid = (trace_lib.new_span_id()
+               if trace_lib.enabled and parent_span is not None else None)
         header = protocol.request_header(
-            uid, trace=trace_id, model=model, version=version,
+            uid, trace=trace_id, span=sid, model=model, version=version,
             deadline_ms=(max(1, int(deadline * 1000))
                          if deadline is not None else None))
         self.conn.send_request(header, np.asarray(arr))
 
-    def forget(self, uid: str) -> None:
+    def forget(self, uid: str
+               ) -> Optional[Tuple[str, float, Optional[str]]]:
+        """Drop the connection's resend record for ``uid``; returns its
+        (trace id, enqueue time, attempt span id) so the router can
+        close out the attempt span."""
         if self._conn is not None:
-            self._conn.forget(uid)
+            return self._conn.forget(uid)
+        return None
 
     def close(self) -> None:
         with self._conn_lock:
@@ -282,8 +295,7 @@ class ReplicaSet:
             name = f"{host}:{port}"
             breaker = CircuitBreaker(
                 threshold=breaker_threshold, reset_s=breaker_reset_s,
-                on_open=self._metrics.counter("router.breaker_opens",
-                                              replica=name).inc)
+                on_open=self._make_on_open(name))
             self._replicas.append(Replica(
                 host, port, self.retry, self._metrics, breaker,
                 labels={"replica": name} if label else None))
@@ -297,6 +309,21 @@ class ReplicaSet:
         self._health_thread: Optional[threading.Thread] = None
         if start_health and len(self._replicas) > 1:
             self.start_health()
+
+    def _make_on_open(self, name: str):
+        """Breaker-open hook: count the transition AND dump the flight
+        record (no-op without a configured dump dir) — a breaker opening
+        is precisely the "replica just failed repeatedly" moment whose
+        lead-up (spans, metric movement, warnings) is worth keeping."""
+        counter = self._metrics.counter("router.breaker_opens",
+                                        replica=name)
+
+        def on_open() -> None:
+            counter.inc()
+            from analytics_zoo_tpu.core import flightrec
+            flightrec.dump("breaker_open", extra={"replica": name})
+
+        return on_open
 
     # -- health ---------------------------------------------------------------
 
@@ -380,6 +407,11 @@ class ReplicaSet:
         until = time.monotonic() + timeout
         uid = f"rs-{uuid_mod.uuid4()}"
         tid = trace_id or trace_lib.new_trace_id()
+        # the request's ROOT span: every replica attempt (primary,
+        # failover, hedge) becomes a child span, and each attempt's
+        # server-side stage spans hang beneath it — trace.tree(tid)
+        # reconstructs root → attempts → server stages
+        root_sid = trace_lib.new_span_id() if trace_lib.enabled else None
         t0 = time.monotonic()
         attempts = 0
         tried: Set[str] = set()      # replicas that failed this request
@@ -408,7 +440,7 @@ class ReplicaSet:
                         r.pending += 1
                     touched.append(r)
                     r.enqueue(uid, arr, deadline, tid, model=model,
-                              version=version)
+                              version=version, parent_span=root_sid)
                 except OSError:
                     r.breaker.record_failure()
                     tried.add(r.name)
@@ -416,7 +448,8 @@ class ReplicaSet:
                 kind, payload, rep = self._await(r, uid, arr, until,
                                                  deadline, tid, tried,
                                                  touched, model=model,
-                                                 version=version)
+                                                 version=version,
+                                                 root_span=root_sid)
                 if kind == "ok":
                     out, header = payload
                     rep.breaker.record_success()
@@ -434,20 +467,27 @@ class ReplicaSet:
                     conn = rep._conn
                     info = conn.forget(uid) if conn is not None else None
                     if info is not None:
-                        _tid, t0c = info
+                        _tid, t0c, att_sid = info
                         total = (time.monotonic() - t0c) * 1000.0
-                        stages = {"client.total_ms": round(total, 3)}
+                        stages = {"client.total_ms": round(total, 3),
+                                  "client.replica": rep.name}
                         if (header or {}).get("stages"):
                             stages.update(header["stages"])
                         conn._m_request.observe(total)
-                        trace_lib.record(tid, "client", stages)
+                        # the WINNING attempt span: its id rode the
+                        # frame header, so the serving replica's stage
+                        # spans already sit beneath it in the tree
+                        trace_lib.record(tid, "client", stages,
+                                         span_id=att_sid,
+                                         parent=root_sid, dur_ms=total)
                         trace_lib.maybe_log_slow(tid, uid, total, stages)
                     trace_lib.record(tid, "router", {
                         "router.replica": rep.name,
                         "router.attempts": attempts,
                         "router.hedge_win": int(hedge_win),
                         "router.total_ms": round(
-                            (time.monotonic() - t0) * 1000.0, 3)})
+                            (time.monotonic() - t0) * 1000.0, 3)},
+                        span_id=root_sid)
                     return out
                 if kind == "error":
                     raise RuntimeError(
@@ -468,9 +508,20 @@ class ReplicaSet:
             return None
         finally:
             for rep in touched:
-                rep.forget(uid)
+                info = rep.forget(uid)
                 with self._lock:
                     rep.pending = max(0, rep.pending - 1)
+                if info is not None and info[2] is not None:
+                    # a LOSING attempt (failed primary, abandoned hedge,
+                    # timeout): close its span so the tree shows every
+                    # replica this request touched, not just the winner
+                    trace_lib.record(
+                        tid, "client.attempt",
+                        {"client.total_ms": round(
+                            (time.monotonic() - info[1]) * 1000.0, 3),
+                         "client.replica": rep.name,
+                         "client.won": 0},
+                        span_id=info[2], parent=root_sid)
 
     def _pick_would_block(self, tried: Set[str]) -> bool:
         with self._lock:
@@ -483,7 +534,8 @@ class ReplicaSet:
     def _await(self, r: Replica, uid: str, arr: np.ndarray, until: float,
                deadline: Optional[float], tid: str, tried: Set[str],
                touched: List[Replica], model: Optional[str] = None,
-               version: Optional[str] = None
+               version: Optional[str] = None,
+               root_span: Optional[str] = None
                ) -> Tuple[str, Any, Optional[Replica]]:
         """Wait for ``uid``'s reply on ``r`` (and on a hedge replica,
         once launched).  Returns ``(kind, payload, replica)`` where kind
@@ -536,7 +588,7 @@ class ReplicaSet:
                     touched.append(h)  # caller cleans up forget/pending
                     try:
                         h.enqueue(uid, arr, deadline, tid, model=model,
-                                  version=version)
+                                  version=version, parent_span=root_span)
                         waiting.append(h)
                         self._m_hedges.inc()
                         logger.debug("hedged %s onto %s", uid, h.name)
@@ -574,6 +626,39 @@ class ReplicaSet:
         status = ("ok" if n_avail == len(reps)
                   else "degraded" if n_avail else "down")
         return {"status": status, "replicas": replicas}
+
+    def cluster_metrics(self, timeout: float = 2.0) -> Dict[str, Any]:
+        """One cluster-level registry snapshot: scrape every ROUTABLE
+        replica's registry over the TCP ``metrics`` frame and fold the
+        snapshots with :meth:`MetricsRegistry.merge`, dropping
+        ``replica=`` labels so per-backend series merge into one
+        cluster series (counters sum, gauge high-water marks
+        max-merge, histogram buckets add).  Unreachable replicas are
+        skipped — a scrape must never block on a dead backend longer
+        than ``timeout``."""
+        with self._lock:
+            reps = [r for r in self._replicas if r.healthy]
+        results: List[Optional[Dict[str, Any]]] = [None] * len(reps)
+
+        def scrape(i: int, r: Replica) -> None:
+            try:
+                results[i] = r.conn.metrics_snapshot(timeout)
+            except OSError:
+                pass
+
+        # concurrent scrape: N wedged-but-connected replicas must cost
+        # ~one timeout total, not timeout × N (a Prometheus scrape job
+        # would give up long before a sequential sweep finished)
+        threads = [threading.Thread(target=scrape, args=(i, r),
+                                    daemon=True)
+                   for i, r in enumerate(reps)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout + 0.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return metrics_lib.MetricsRegistry.merge(
+            [s for s in results if s], drop_labels=("replica",))
 
     def stats(self) -> Dict[str, Any]:
         """Per-replica resilience counters (each connection's

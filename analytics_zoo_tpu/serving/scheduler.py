@@ -94,6 +94,12 @@ class Scheduler:
         dropping them.  Called after the assembly thread exits."""
         return []
 
+    def held_rows(self) -> List[Any]:
+        """NON-destructive view of the rows ``drain_rows`` would hand
+        back — the flight recorder reads this to name the in-flight
+        work a dying replica holds without disturbing the backlog."""
+        return []
+
     def _finish_round(self, server: Any, batch: List[Any]) -> None:
         # injected latency (armed spec's ``delay``) lands HERE, in the
         # single ordered stage, before shedding — so an armed delay
@@ -207,6 +213,22 @@ class ContinuousScheduler(Scheduler):
             rows.extend(d)
             d.clear()
         return rows
+
+    def held_rows(self) -> List[Any]:
+        # best-effort: the assembly thread may be mutating these deques
+        # concurrently (the flight recorder reads this mid-kill); a torn
+        # snapshot is retried once, then whatever was gathered is enough
+        for _ in range(2):
+            try:
+                rows = list(self._pings)
+                if self._held is not None:
+                    rows.append(self._held)
+                for d in list(self._backlog.values()):
+                    rows.extend(list(d))
+                return rows
+            except RuntimeError:
+                continue  # mutated during iteration: try once more
+        return []
 
     def run(self, server: Any) -> None:
         while not server._stop.is_set():

@@ -91,7 +91,8 @@ def _config_default(field: str, fallback: Any) -> Any:
 
 class _Pending:
     __slots__ = ("uuid", "arr", "conn", "lock", "writer", "expires",
-                 "trace", "enq_t", "wait_ms", "ping", "model", "version")
+                 "trace", "span", "enq_t", "wait_ms", "ping", "model",
+                 "version")
 
     def __init__(self, uid: str, arr: Optional[np.ndarray],
                  conn: socket.socket,
@@ -99,7 +100,8 @@ class _Pending:
                  expires: Optional[float] = None,
                  trace: Optional[str] = None, ping: bool = False,
                  model: Optional[str] = None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 span: Optional[str] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
@@ -111,6 +113,9 @@ class _Pending:
         # trace id from the frame header (core/trace.py): rides every
         # reply so the client can correlate its per-stage breakdown
         self.trace = trace
+        # the SENDER's span id from the frame header: the parent this
+        # request's server-side stage spans attach under in trace.tree()
+        self.span = span
         self.enq_t = time.monotonic()  # arrival → assembly = queue wait
         self.wait_ms = 0.0             # filled at assembly pickup
         self.ping = ping               # health probe: answered, not batched
@@ -213,13 +218,23 @@ class _ConnWriter:
                     return  # closed AND flushed
                 continue
             header, arr = item
-            with self._m_reply.time():
-                try:
-                    with self._lock:
-                        protocol.send_frame_parts(
-                            self._conn, protocol.encode_parts(header, arr))
-                except (OSError, ValueError):
-                    pass  # client gone; counters were final pre-send
+            t0 = time.monotonic()
+            try:
+                with self._lock:
+                    protocol.send_frame_parts(
+                        self._conn, protocol.encode_parts(header, arr))
+            except (OSError, ValueError):
+                pass  # client gone; counters were final pre-send
+            reply_ms = (time.monotonic() - t0) * 1000.0
+            self._m_reply.observe(reply_ms)
+            if header.get("span") is not None and trace_lib.enabled:
+                # the reply-writer stage span: only measurable here,
+                # after the send — parents under the server.batch span
+                # whose id rides the reply header
+                tid = header.get("trace")
+                trace_lib.record(tid, "server.reply",
+                                 {"reply_ms": round(reply_ms, 3)},
+                                 parent=header["span"], dur_ms=reply_ms)
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop after flushing queued replies (sends to a dead socket
@@ -550,15 +565,62 @@ class ClusterServing:
             time.sleep(0.01)
         return False
 
+    def _inflight_traces(self) -> List[str]:
+        """Trace ids of every request this replica currently holds —
+        queued (``_pending``), parked in the scheduler's backlog, or
+        assembled and waiting for a worker.  What the flight recorder
+        names when the replica dies: the requests a sibling replica (or
+        a client replay) must pick up."""
+        with self._pending_lock:
+            tids = [p.trace for p in self._pending.values()
+                    if p.trace is not None and not p.ping]
+        for p in self.scheduler.held_rows():
+            if p.trace is not None and not p.ping:
+                tids.append(p.trace)
+        with self._batch_q.mutex:
+            batches = list(self._batch_q.queue)
+        for ab in batches:
+            tids.extend(p.trace for p in ab.group if p.trace is not None)
+        return tids
+
+    def dump_flight_record(self, reason: str = "on_demand",
+                           dump_dir: Optional[str] = None
+                           ) -> Optional[str]:
+        """Dump this process's flight record (core/flightrec.py) with
+        this replica's context: address, lifecycle state, counters, and
+        the trace ids currently in flight here.  Returns the dump path,
+        or None when no dump directory is configured.  Never raises —
+        the kill() path calls this BEFORE tearing anything down, and
+        the scheduler's live backlog races the still-running assembly
+        thread (a torn in-flight listing beats no dump, and no dump
+        must never beat the kill itself)."""
+        from analytics_zoo_tpu.core import flightrec
+        try:
+            tids = self._inflight_traces()
+        except Exception:  # noqa: BLE001 — assembly still mutating
+            tids = []
+        return flightrec.dump(reason, dump_dir=dump_dir, extra={
+            "replica": f"{self.host}:{self.port}",
+            "state": self.state,
+            "in_flight_traces": tids,
+            "scheduler": self.scheduler.name,
+        })
+
     def kill(self) -> None:
         """Die the way SIGKILL would: close every socket NOW — no drain
         replies, no writer flushes, pending requests simply vanish.
         This is the ``serving.replica_down`` failure mode the router's
         failover (reconnect + idempotent re-enqueue on a sibling
         replica) must absorb; tests use it to hard-kill an in-process
-        replica without losing the process."""
+        replica without losing the process.
+
+        The flight recorder fires FIRST (best-effort, while ``_pending``
+        still names the in-flight work): the dump is the only record of
+        which requests died here — by the time the router notices, this
+        replica has no state left to ask."""
         if self._stop.is_set():
             return
+        self.dump_flight_record("serving.replica_down")
         self._stop.set()
         self.registry.off_unload(self._retire_model_series)
         self._workers_done.set()
@@ -730,6 +792,15 @@ class ClusterServing:
                 if header.get("type") == protocol.PING:
                     self._enqueue_ping(uid, tid, conn, send_lock, writer)
                     continue
+                if header.get("type") == protocol.METRICS:
+                    # telemetry scrape: answered inline (a registry read,
+                    # no queue slot, no request accounting) so a cluster
+                    # scrape works even against a draining replica
+                    with send_lock:
+                        protocol.send_frame(conn, protocol.encode(
+                            {"uuid": uid, "trace": tid,
+                             "metrics": self._metrics.snapshot()}))
+                    continue
                 self._count(requests=1)
                 if self._draining.is_set():
                     # retryable by design: the client backs off and its
@@ -790,7 +861,8 @@ class ClusterServing:
                     self._pending[rid] = _Pending(uid, arr, conn, send_lock,
                                                   writer, expires,
                                                   trace=tid, model=mname,
-                                                  version=mver)
+                                                  version=mver,
+                                                  span=header.get("span"))
                 # occupancy BEFORE the push: the assembly stage may pop
                 # (and decrement) the instant push returns, and a +1 that
                 # lands after the -1 would miss the high-water mark
@@ -1177,6 +1249,7 @@ class ClusterServing:
             self._count(replies=len(group))
             for p, row in zip(group, out):
                 stages = None
+                sid = None
                 if p.trace is not None:
                     # per-stage breakdown rides the reply header so
                     # the client can answer "where did the latency
@@ -1186,9 +1259,27 @@ class ClusterServing:
                         "server.assembly_ms": round(ab.assembly_ms, 3),
                         "server.inference_ms": round(infer_ms, 3),
                         "server.batch_size": len(group)}
-                    trace_lib.record(p.trace, "server.batch", stages)
+                    if trace_lib.enabled:
+                        # span tree: server.batch parents under the
+                        # client attempt span from the frame header;
+                        # the pipeline stages hang beneath it (the
+                        # reply-writer stage attaches in _ConnWriter
+                        # once the send actually happened)
+                        sid = trace_lib.new_span_id()
+                        trace_lib.record(p.trace, "server.batch", stages,
+                                         span_id=sid, parent=p.span)
+                        trace_lib.record(
+                            p.trace, "server.assembly",
+                            {"assembly_ms": round(ab.assembly_ms, 3)},
+                            parent=sid, dur_ms=ab.assembly_ms)
+                        trace_lib.record(
+                            p.trace, "server.inference",
+                            {"inference_ms": round(infer_ms, 3)},
+                            parent=sid, dur_ms=infer_ms)
                 hdr = {"uuid": p.uuid, "trace": p.trace,
                        "stages": stages}
+                if sid is not None:
+                    hdr["span"] = sid
                 if p.model is not None:
                     # name the (resolved) serving version only for
                     # requests that routed by model explicitly — the

@@ -1,12 +1,14 @@
 #!/usr/bin/env python
-"""CI guard: the metric catalog in docs/observability.md matches the code.
+"""CI guard: the metric AND span catalogs in docs/observability.md match
+the code.
 
-The catalog drifted risk-free through four PRs — nothing failed when a
-new series was registered but never documented, or a documented series
-was renamed away.  This checker closes the loop without importing (or
-running) anything:
+The metric catalog drifted risk-free through four PRs — nothing failed
+when a new series was registered but never documented, or a documented
+series was renamed away.  This checker closes the loop without importing
+(or running) anything; since ISSUE 9 it guards the SPAN catalog the same
+way, so span naming can't drift undocumented either:
 
-- **code side**: every metric name registered through the
+- **metrics, code side**: every metric name registered through the
   ``core/metrics.py`` registry is found by scanning ``analytics_zoo_tpu``
   sources for ``counter("...")`` / ``gauge("...")`` /
   ``histogram("...")`` / ``inc("...")`` / ``observe("...")`` /
@@ -15,12 +17,19 @@ running) anything:
   ``"server." + k`` over the server's counters dict, ``"frontend." +
   key`` over ``_FRONTEND_COUNTERS``) whose key sets are extracted from
   the same files;
-- **docs side**: the first column of the catalog table (rows starting
-  with ``| `` + a backtick), splitting ``a / b`` cells.
+- **spans, code side**: every span name recorded through ``core/trace.py``
+  — the second argument of ``trace.record(...)`` / ``trace_lib.record``
+  call sites and the first argument of ``trace.span("...")`` /
+  ``.child("...")`` — as string literals (span names are a closed
+  vocabulary by design; build one from a variable and this guard can't
+  see it, so don't);
+- **docs side**: the first column of the catalog tables (rows starting
+  with ``| `` + a backtick), splitting ``a / b`` cells — metric rows
+  from the "## Metric catalog" section, span rows from the
+  "## Span catalog" section.
 
-Exit 1 (with a readable diff) when the code registers a series the
-catalog doesn't document, or the catalog documents a series no code
-registers.  Wired into the test suite
+Exit 1 (with a readable diff) when code and catalog disagree in either
+direction, for either vocabulary.  Wired into the test suite
 (``tests/test_observability.py::test_metric_catalog_matches_code``).
 """
 
@@ -38,6 +47,13 @@ DOC = REPO / "docs" / "observability.md"
 _LITERAL = re.compile(
     r'\.(?:counter|gauge|histogram|inc|observe|set_gauge)\(\s*'
     r'"([a-z0-9_.]+)"')
+
+#: span-producing calls: record(<expr>, "name", ...) / span("name") /
+#: sp.child("name").  The record() first argument never contains a
+#: comma at this call depth (a bare name, attribute, or subscript).
+_SPAN_RECORD = re.compile(
+    r'\.record\(\s*\n?\s*[^,()]+,\s*\n?\s*"([a-z0-9_.]+)"', re.S)
+_SPAN_CTX = re.compile(r'\.(?:span|child)\(\s*"([a-z0-9_.]+)"')
 
 #: dynamic registration sites: (file, metric prefix, regex whose group 1
 #: holds the key set as quoted strings)
@@ -75,31 +91,59 @@ def code_metrics() -> set:
     return {n for n in names if not n.endswith(".")}
 
 
-def documented_metrics() -> set:
+def code_spans() -> set:
     names: set = set()
-    for cell in _DOC_ROW.findall(DOC.read_text()):
+    for py in sorted(PKG.rglob("*.py")):
+        text = py.read_text()
+        names.update(_SPAN_RECORD.findall(text))
+        names.update(_SPAN_CTX.findall(text))
+    return names
+
+
+def _doc_section(heading: str) -> str:
+    text = DOC.read_text()
+    m = re.search(rf"\n(#{{2,3}}) {re.escape(heading)}\n", text)
+    if m is None:
+        print(f"check_metric_docs: docs/observability.md has no "
+              f"'{heading}' section", file=sys.stderr)
+        sys.exit(2)
+    body = text[m.end():]
+    # the section runs until the next heading of the same-or-higher level
+    nxt = re.search(rf"\n#{{2,{len(m.group(1))}}} ", body)
+    return body if nxt is None else body[:nxt.start()]
+
+
+def documented(heading: str) -> set:
+    names: set = set()
+    for cell in _DOC_ROW.findall(_doc_section(heading)):
         names.update(_DOC_NAME.findall(cell))
     return names
 
 
-def main() -> int:
-    code = code_metrics()
-    docs = documented_metrics()
+def _diff(kind: str, code: set, docs: set) -> bool:
     undocumented = sorted(code - docs)
     stale = sorted(docs - code)
     if undocumented:
-        print("metrics registered in code but MISSING from the "
-              "docs/observability.md catalog:")
+        print(f"{kind} in code but MISSING from the docs/observability.md "
+              "catalog:")
         for n in undocumented:
             print(f"  - {n}")
     if stale:
-        print("metrics documented in docs/observability.md but no longer "
-              "registered anywhere in analytics_zoo_tpu/:")
+        print(f"{kind} documented in docs/observability.md but no longer "
+              "in analytics_zoo_tpu/:")
         for n in stale:
             print(f"  - {n}")
-    if undocumented or stale:
+    return bool(undocumented or stale)
+
+
+def main() -> int:
+    bad = _diff("metrics", code_metrics(), documented("Metric catalog"))
+    bad = _diff("span names", code_spans(),
+                documented("Span catalog")) or bad
+    if bad:
         return 1
-    print(f"metric catalog in sync: {len(code)} series")
+    print(f"metric catalog in sync: {len(code_metrics())} series; "
+          f"span catalog in sync: {len(code_spans())} names")
     return 0
 
 

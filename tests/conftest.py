@@ -43,6 +43,7 @@ def _telemetry_reset():
     metrics.get_registry().reset()
     metrics.get_registry().enabled = True
     trace.reset()
+    trace.enabled = True
     yield
 
 
