@@ -179,24 +179,33 @@ class FeatureTable:
         """Implicit-feedback training data: every existing row becomes a
         positive (label 1) and gains ``neg_num`` copies with a random item
         and label 0 (reference: add_negative_samples).  ``item_size`` is the
-        exclusive upper item-id bound; sampled ids start at 1 (0 = pad)."""
+        exclusive upper item-id bound; sampled ids start at 1 (0 = pad).
 
-        def do(df: pd.DataFrame, idx: int = 0) -> pd.DataFrame:
-            rng = np.random.default_rng(seed + idx)
+        Sampling is counter-based on ``(seed, global row, slot)`` — each
+        negative is a pure function of the row's GLOBAL position, not of
+        which shard holds it, so the same rows with the same ``seed``
+        yield the same negatives across runs AND across shard counts
+        (1-shard debugging reproduces the 64-shard job)."""
+        if item_size < 2:
+            raise ValueError(
+                f"item_size must be >= 2 (ids sample from [1, item_size)),"
+                f" got {item_size}")
+
+        def do(df: pd.DataFrame, start: int) -> pd.DataFrame:
+            gidx = np.arange(start, start + len(df), dtype=np.uint64)
             pos = df.copy()
             pos[label_col] = 1
             negs = []
-            for _ in range(neg_num):
+            for j in range(neg_num):
                 neg = df.copy()
-                neg[item_col] = rng.integers(1, item_size, len(df))
+                neg[item_col] = _counter_sample(seed, gidx, j, item_size)
                 neg[label_col] = 0
                 negs.append(neg)
             return pd.concat([pos] + negs, ignore_index=True)
 
-        # per-shard seed via enumerate (transform_shard passes only the df,
-        # so close over a counter list)
         dfs = self.shards.collect()
-        out = [do(df, i) for i, df in enumerate(dfs)]
+        offsets = np.concatenate([[0], np.cumsum([len(d) for d in dfs])])
+        out = [do(df, int(offsets[i])) for i, df in enumerate(dfs)]
         return FeatureTable(XShards(out))
 
     # -- splits / export -------------------------------------------------------
@@ -226,6 +235,23 @@ class FeatureTable:
         from analytics_zoo_tpu.data import DataFeed
         d = self.to_numpy_dict(feature_cols, label_col)
         return DataFeed(d, batch_size, **kw)
+
+
+def _counter_sample(seed: int, gidx: np.ndarray, slot: int,
+                    item_size: int) -> np.ndarray:
+    """Deterministic item ids in ``[1, item_size)`` from ``(seed, global
+    row index, negative slot)`` — a vectorized splitmix64 finalizer, so
+    the draw depends only on the row's global position (shard-count
+    invariant by construction)."""
+    mask = np.uint64(0xFFFFFFFFFFFFFFFF)
+    key = np.uint64((seed * 0x9E3779B97F4A7C15
+                     + (slot + 1) * 0xBF58476D1CE4E5B9)
+                    & 0xFFFFFFFFFFFFFFFF)
+    x = (gidx.astype(np.uint64) * np.uint64(0x94D049BB133111EB)) ^ key
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9) & mask
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB) & mask
+    x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(item_size - 1) + np.uint64(1)).astype(np.int64)
 
 
 def _stable_hash(s: str) -> int:

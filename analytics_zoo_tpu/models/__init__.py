@@ -8,8 +8,9 @@ plus the BERT family the reference shipped through TFPark.
 """
 
 from .common import ZooModel
-from .recommendation import (NeuralCF, SessionRecommender, UserItemFeature,
-                             UserItemPrediction, WideAndDeep)
+from .recommendation import (NCFTail, NeuralCF, SessionRecommender,
+                             UserItemFeature, UserItemPrediction,
+                             WideAndDeep)
 from .textclassification import TextClassifier
 from .textmatching import KNRM
 from .anomalydetection import AnomalyDetector, unroll
@@ -22,7 +23,7 @@ from .net import ForeignNet, Net
 
 __all__ = [
     "Net", "ForeignNet", "GraphNet",
-    "ZooModel", "NeuralCF", "WideAndDeep", "SessionRecommender",
+    "ZooModel", "NeuralCF", "NCFTail", "WideAndDeep", "SessionRecommender",
     "UserItemFeature", "UserItemPrediction", "TextClassifier", "KNRM",
     "AnomalyDetector", "unroll", "Seq2seq", "RNNEncoder", "RNNDecoder",
     "ImageClassifier", "ResNet", "ObjectDetector", "SSDLite", "Visualizer",

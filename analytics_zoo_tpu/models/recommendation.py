@@ -39,20 +39,39 @@ class UserItemPrediction:
     probability: float
 
 
+def _make_embedding(count: int, dim: int, sharded: bool):
+    """The embedding layer behind one id column: the classic replicated
+    ``nn.Embedding`` (default — bit-identical to the pre-sharding models),
+    or ``parallel.ShardedEmbedding`` (row-partitioned table, deduped
+    gather, sparse scatter-add grads) when ``sharded_embeddings=True``."""
+    if sharded:
+        from analytics_zoo_tpu.parallel.embedding import ShardedEmbedding
+        return ShardedEmbedding(count, dim)
+    return nn.Embedding(count, dim)
+
+
 class NeuralCF(ZooModel):
     """Neural Collaborative Filtering: GMF ⊙ + MLP concat towers
-    (reference: models/recommendation/NeuralCF.scala; He et al. NCF)."""
+    (reference: models/recommendation/NeuralCF.scala; He et al. NCF).
+
+    ``sharded_embeddings=True`` swaps every id table for a
+    ``parallel.ShardedEmbedding`` — same child names and initializer, so
+    checkpoints keep their paths — enabling mesh-partitioned tables and
+    the estimator's sparse-gradient path for user/item counts too large
+    to replicate.  Default off; the default path is unchanged."""
 
     def __init__(self, user_count: int, item_count: int, class_num: int = 2,
                  user_embed: int = 20, item_embed: int = 20,
                  hidden_layers: Sequence[int] = (40, 20, 10),
-                 include_mf: bool = True, mf_embed: int = 20):
+                 include_mf: bool = True, mf_embed: int = 20,
+                 sharded_embeddings: bool = False):
         super().__init__()
         self._config = dict(user_count=user_count, item_count=item_count,
                             class_num=class_num, user_embed=user_embed,
                             item_embed=item_embed,
                             hidden_layers=list(hidden_layers),
-                            include_mf=include_mf, mf_embed=mf_embed)
+                            include_mf=include_mf, mf_embed=mf_embed,
+                            sharded_embeddings=sharded_embeddings)
         self.user_count = user_count
         self.item_count = item_count
         self.class_num = class_num
@@ -61,25 +80,60 @@ class NeuralCF(ZooModel):
         self.hidden_layers = list(hidden_layers)
         self.include_mf = include_mf
         self.mf_embed = mf_embed
+        self.sharded_embeddings = sharded_embeddings
 
     def forward(self, scope, x):
         """x: int [B, 2] — (user_id, item_id), ids in [0, count)."""
         users, items = x[:, 0], x[:, 1]
-        ue = scope.child(nn.Embedding(self.user_count, self.user_embed),
-                         users, name="mlp_user_embed")
-        ie = scope.child(nn.Embedding(self.item_count, self.item_embed),
-                         items, name="mlp_item_embed")
+        sh = self.sharded_embeddings
+        ue = scope.child(_make_embedding(self.user_count, self.user_embed,
+                                         sh), users, name="mlp_user_embed")
+        ie = scope.child(_make_embedding(self.item_count, self.item_embed,
+                                         sh), items, name="mlp_item_embed")
         h = jnp.concatenate([ue, ie], axis=-1)
         for i, units in enumerate(self.hidden_layers):
             h = scope.child(nn.Dense(units, activation="relu"), h,
                             name=f"mlp_{i}")
         if self.include_mf:
-            mu = scope.child(nn.Embedding(self.user_count, self.mf_embed),
-                             users, name="mf_user_embed")
-            mi = scope.child(nn.Embedding(self.item_count, self.mf_embed),
-                             items, name="mf_item_embed")
+            mu = scope.child(_make_embedding(self.user_count, self.mf_embed,
+                                             sh), users,
+                             name="mf_user_embed")
+            mi = scope.child(_make_embedding(self.item_count, self.mf_embed,
+                                             sh), items,
+                             name="mf_item_embed")
             h = jnp.concatenate([mu * mi, h], axis=-1)
         return scope.child(nn.Dense(self.class_num), h, name="head")
+
+    # -- cached-serving split -------------------------------------------------
+
+    def embedding_columns(self):
+        """The id-table columns this model gathers per example, in the
+        order ``NCFTail`` consumes them: (name, which id, table param
+        path) — the contract between ``serving_split`` tables and the
+        serving-side cache."""
+        cols = [("mlp_user_embed", "user"), ("mlp_item_embed", "item")]
+        if self.include_mf:
+            cols += [("mf_user_embed", "user"), ("mf_item_embed", "item")]
+        return cols
+
+    def serving_split(self, variables):
+        """Split trained ``variables`` for the cached serving path:
+        ``(tables, tail_module, tail_variables)`` where ``tables`` maps
+        embedding child name → host ``np.ndarray`` table and the tail is
+        an ``NCFTail`` whose child names match this model's — trained
+        dense params apply to it unchanged.  The serving side gathers
+        rows (through ``serving.EmbedCache``) and runs only the tail on
+        device."""
+        params = variables["params"]
+        leaf = ("sharded_embeddings" if self.sharded_embeddings
+                else "embeddings")
+        tables = {name: np.asarray(params[name][leaf])
+                  for name, _ in self.embedding_columns()}
+        tail_keys = [f"mlp_{i}" for i in range(len(self.hidden_layers))]
+        tail_keys.append("head")
+        tail_vars = {"params": {k: params[k] for k in tail_keys},
+                     "state": {}}
+        return tables, NCFTail(self), tail_vars
 
     # -- reference recommend APIs --------------------------------------------
 
@@ -93,6 +147,41 @@ class NeuralCF(ZooModel):
                            ) -> List[UserItemPrediction]:
         return _recommend(self, np.arange(self.user_count), item_ids,
                           per="item", k=max_users)
+
+
+class NCFTail(ZooModel):
+    """NeuralCF minus its embedding gathers: input is the concatenation
+    of the already-gathered embedding vectors ``[ue | ie | mu | mi]``
+    (``[ue | ie]`` without MF), output the class logits.  Child names
+    (``mlp_i``, ``head``) mirror ``NeuralCF``'s, so the dense params from
+    a trained NCF apply directly — ``NeuralCF.serving_split`` extracts
+    both halves.  This is the on-device part of the cached serving path:
+    the host cache supplies rows, the tail is a small dense MLP."""
+
+    def __init__(self, ncf: "NeuralCF"):
+        super().__init__()
+        self.user_embed = ncf.user_embed
+        self.item_embed = ncf.item_embed
+        self.hidden_layers = list(ncf.hidden_layers)
+        self.include_mf = ncf.include_mf
+        self.mf_embed = ncf.mf_embed
+        self.class_num = ncf.class_num
+
+    def input_dim(self) -> int:
+        return (self.user_embed + self.item_embed
+                + (2 * self.mf_embed if self.include_mf else 0))
+
+    def forward(self, scope, x):
+        cut = self.user_embed + self.item_embed
+        h = x[:, :cut]
+        for i, units in enumerate(self.hidden_layers):
+            h = scope.child(nn.Dense(units, activation="relu"), h,
+                            name=f"mlp_{i}")
+        if self.include_mf:
+            mu = x[:, cut:cut + self.mf_embed]
+            mi = x[:, cut + self.mf_embed:cut + 2 * self.mf_embed]
+            h = jnp.concatenate([mu * mi, h], axis=-1)
+        return scope.child(nn.Dense(self.class_num), h, name="head")
 
 
 class WideAndDeep(ZooModel):
@@ -111,7 +200,8 @@ class WideAndDeep(ZooModel):
                  embed_in_dims: Sequence[int] = (),
                  embed_out_dims: Sequence[int] = (),
                  continuous_cols: int = 0,
-                 hidden_layers: Sequence[int] = (40, 20, 10)):
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 sharded_embeddings: bool = False):
         super().__init__()
         self._config = dict(class_num=class_num, model_type=model_type,
                             wide_base_dims=list(wide_base_dims),
@@ -120,7 +210,8 @@ class WideAndDeep(ZooModel):
                             embed_in_dims=list(embed_in_dims),
                             embed_out_dims=list(embed_out_dims),
                             continuous_cols=continuous_cols,
-                            hidden_layers=list(hidden_layers))
+                            hidden_layers=list(hidden_layers),
+                            sharded_embeddings=sharded_embeddings)
         for k, v in self._config.items():
             setattr(self, k, v)
         self.wide_dim = sum(wide_base_dims) + sum(wide_cross_dims)
@@ -138,8 +229,9 @@ class WideAndDeep(ZooModel):
                                                   self.embed_out_dims)):
             ids = x[:, ofs].astype(jnp.int32)
             ofs += 1
-            embeds.append(scope.child(nn.Embedding(in_dim, out_dim), ids,
-                                      name=f"embed_{i}"))
+            embeds.append(scope.child(
+                _make_embedding(in_dim, out_dim, self.sharded_embeddings),
+                ids, name=f"embed_{i}"))
         cont = x[:, ofs:ofs + self.continuous_cols]
 
         if self.model_type in ("wide", "wide_n_deep"):

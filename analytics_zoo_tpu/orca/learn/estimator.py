@@ -183,6 +183,7 @@ class ZooEstimator:
                  nan_max_rollbacks: int = 3,
                  augment: Any = None,
                  grad_compression: Optional[str] = None,
+                 embedding_lr: Optional[float] = None,
                  profile: Any = None):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
@@ -268,6 +269,15 @@ class ZooEstimator:
         evaluate/predict run it deterministically (center crop, no flip,
         normalize applies).
 
+        ``embedding_lr``: row learning rate for ``ShardedEmbedding``
+        tables (parallel/embedding.py).  Sparse tables update by plain
+        SGD scatter-add on the batch's unique rows — stateful optimizers
+        would need full ``[rows, dim]`` moment tensors, recreating the
+        memory problem the sharded table exists to avoid — so their rate
+        is decoupled from the dense optimizer's schedule.  Default: the
+        numeric ``learning_rate`` if one was given, else 1e-3.  Ignored
+        for models without sparse tables.
+
         ``profile``: the step profiler (ISSUE 9) — ``None`` (off, zero
         overhead), ``True``, or a dict:
 
@@ -327,6 +337,9 @@ class ZooEstimator:
                     "(the compressed collective already decomposes the "
                     "batch per shard)")
         self.grad_compression = grad_compression
+        self.embedding_lr = embedding_lr
+        self._learning_rate = learning_rate
+        self._sparse_paths: tuple = ()  # ShardedEmbedding table paths
         self._grad_bytes_step = 0   # analytic wire bytes per train step
         self._comm_fn = None        # jitted all-reduce-only probe
         self._warned_mesh = False
@@ -395,6 +408,48 @@ class ZooEstimator:
             {"train": self.tx, "freeze": optax.set_to_zero()}, labels)
         self._tx_wrapped = True
 
+    def _check_sparse_support(self) -> None:
+        """Feature-interaction guardrails for ShardedEmbedding models:
+        fail at init with an actionable message instead of silently
+        training wrong (or densifying the very gradient the sparse path
+        exists to avoid)."""
+        if not self._sparse_paths:
+            return
+        if self.grad_accum > 1:
+            raise ValueError(
+                "grad_accum > 1 is not supported with ShardedEmbedding "
+                f"tables (found {list(self._sparse_paths)}): the "
+                "accumulation scan would need a dense [rows, dim] "
+                "gradient carry, defeating the sparse update.  Use "
+                "grad_accum=1 (the deduped gather already keeps the "
+                "per-step embedding traffic small).")
+        if self.grad_compression in ("bf16", "int8"):
+            raise ValueError(
+                "grad_compression='bf16'/'int8' is not supported with "
+                f"ShardedEmbedding tables (found "
+                f"{list(self._sparse_paths)}): sparse row gradients "
+                "always travel f32 and never enter the quantized "
+                "collective.  Use grad_compression=None (or 'none' for "
+                "wire metering of the dense leaves).")
+        if self.frozen is not None:
+            pred = (self.frozen if callable(self.frozen)
+                    else lambda p, pre=tuple(self.frozen):
+                    any(p == x or p.startswith(x + "/") for x in pre))
+            if any(pred(p) for p in self._sparse_paths):
+                raise ValueError(
+                    "frozen= matches a ShardedEmbedding table "
+                    f"({[p for p in self._sparse_paths if pred(p)]}); "
+                    "sparse tables bypass the optax freeze machinery — "
+                    "remove them from frozen= (they can be excluded from "
+                    "updates by setting embedding_lr=0.0).")
+
+    def _embed_lr(self) -> float:
+        if self.embedding_lr is not None:
+            return float(self.embedding_lr)
+        if isinstance(self._learning_rate, (int, float)):
+            return float(self._learning_rate)
+        return 1e-3
+
     def _ensure_initialized(self, example_x: Any) -> None:
         if self._ts is not None:
             return
@@ -413,7 +468,15 @@ class ZooEstimator:
         variables = jax.jit(
             lambda r, x: self.model.init(r, x, training=True)
         )(rng, example_x)
-        self._wrap_frozen_tx(variables["params"])
+        from analytics_zoo_tpu.parallel import embedding as emb_lib
+        self._sparse_paths = emb_lib.sparse_paths(variables["params"])
+        self._check_sparse_support()
+        # sparse tables never see the dense optimizer — freeze labels and
+        # opt_state are built over the dense part only (identical to the
+        # full tree when no ShardedEmbedding is present)
+        dense_of = (lambda p: emb_lib.split_sparse(p)[0]) \
+            if self._sparse_paths else (lambda p: p)
+        self._wrap_frozen_tx(dense_of(variables["params"]))
         self._warn_strategy_mesh_mismatch(mesh)
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
@@ -423,14 +486,14 @@ class ZooEstimator:
             # jit propagates the param shardings into mu/nu etc., so the
             # optimizer state is sharded exactly like its parameters
             opt_state = _ensure_on_mesh(
-                jax.jit(self.tx.init)(variables["params"]), mesh)
+                jax.jit(self.tx.init)(dense_of(variables["params"])), mesh)
             params = variables["params"]
         else:
             # "dp": replicate params; batches arrive sharded, so jit's
             # propagation yields psum'd (replicated) gradients
             params = jax.device_put(variables["params"], replicated)
-            opt_state = jax.device_put(self.tx.init(variables["params"]),
-                                       replicated)
+            opt_state = jax.device_put(
+                self.tx.init(dense_of(variables["params"])), replicated)
         ts = {"params": params,
               "state": jax.device_put(variables["state"], replicated),
               "opt_state": opt_state,
@@ -511,6 +574,10 @@ class ZooEstimator:
         aug = self.augment
         comp = self.grad_compression
         compress_wire = comp in ("bf16", "int8")
+        sparse_paths = self._sparse_paths
+        embed_lr = self._embed_lr()
+        if sparse_paths:
+            from analytics_zoo_tpu.parallel import embedding as emb_lib
         if compress_wire:
             from analytics_zoo_tpu.parallel.util import (
                 batch_shard_count, batch_shard_spec, compressed_allreduce)
@@ -603,13 +670,55 @@ class ZooEstimator:
                 new_state = jax.tree_util.tree_map(_merge_shard_leaf,
                                                    states)
                 loss_val = shard_losses.mean()
+            elif sparse_paths:
+                # sparse-embedding step: differentiate the DENSE params
+                # plus per-lookup "taps" on the gathered unique rows —
+                # the tap gradient IS the [unique, dim] row gradient, so
+                # the backward pass never materializes (and the optimizer
+                # never shadows) a [rows, dim] dense table gradient.
+                dense_p, tables = emb_lib.split_sparse(ts["params"])
+                # abstract pass (zero runtime): each lookup's static
+                # unique-buffer shape, keyed by table application
+                tap_shapes = emb_lib.record_tap_shapes(
+                    lambda: lossf(ts["params"], batch["x"], batch["y"],
+                                  ts["state"], step_rng))
+                taps = {k: jnp.zeros(s.shape, s.dtype)
+                        for k, s in tap_shapes.items()}
+
+                def lossf_sparse(dense_params, taps, xb, yb, state, rng):
+                    merged = emb_lib.merge_sparse(dense_params, tables)
+                    with emb_lib.inject_taps(taps) as uniqs:
+                        loss, new_state = lossf(merged, xb, yb, state,
+                                                rng)
+                    return loss, (new_state, uniqs)
+
+                ((loss_val, (new_state, uniqs)),
+                 (grads, tap_grads)) = jax.value_and_grad(
+                    lossf_sparse, argnums=(0, 1), has_aux=True)(
+                        dense_p, taps, batch["x"], batch["y"],
+                        ts["state"], step_rng)
             else:
                 (loss_val, new_state), grads = jax.value_and_grad(
                     lossf, has_aux=True)(ts["params"], batch["x"],
                                          batch["y"], ts["state"], step_rng)
-            updates, opt_state = tx.update(grads, ts["opt_state"],
-                                           ts["params"])
-            params = optax.apply_updates(ts["params"], updates)
+            if sparse_paths:
+                # dense optimizer over dense params; sparse tables update
+                # below by scatter-add on the unique rows only
+                updates, opt_state = tx.update(grads, ts["opt_state"],
+                                               dense_p)
+                dense_new = optax.apply_updates(dense_p, updates)
+                new_tables = dict(tables)
+                for key, g in tap_grads.items():
+                    tp = emb_lib.table_path_of(key)
+                    new_tables[tp] = new_tables[tp].at[uniqs[key]].add(
+                        (-embed_lr * g).astype(new_tables[tp].dtype))
+                params = emb_lib.merge_sparse(dense_new, new_tables)
+                grads_for_norm = (grads, tap_grads)
+            else:
+                updates, opt_state = tx.update(grads, ts["opt_state"],
+                                               ts["params"])
+                params = optax.apply_updates(ts["params"], updates)
+                grads_for_norm = grads
             bad_steps = ts["bad_steps"]
             if guard_skip:
                 # in-jit self-healing: a non-finite loss or gradient keeps
@@ -618,7 +727,7 @@ class ZooEstimator:
                 # the pre-step buffers are gone once the call returns, so
                 # a host-side "skip" could never restore them.
                 ok = jnp.isfinite(loss_val) & jnp.isfinite(
-                    optax.global_norm(grads))
+                    optax.global_norm(grads_for_norm))
 
                 def keep(new, old):
                     return jnp.where(ok, new, old)
@@ -640,8 +749,8 @@ class ZooEstimator:
                 # (backward-only overflow) is not missed: report NaN, and
                 # the host-side policy reacts exactly as for a NaN loss
                 loss_val = jnp.where(
-                    jnp.isfinite(optax.global_norm(grads)), loss_val,
-                    jnp.nan)
+                    jnp.isfinite(optax.global_norm(grads_for_norm)),
+                    loss_val, jnp.nan)
             new_ts = {"params": params, "state": new_state,
                       "opt_state": opt_state, "step": ts["step"] + 1,
                       "rng": ts["rng"], "bad_steps": bad_steps}
@@ -697,8 +806,12 @@ class ZooEstimator:
         self._pred_step = jax.jit(pred_step)
         if comp is not None:
             from analytics_zoo_tpu.parallel.util import grad_wire_bytes
-            self._grad_bytes_step = grad_wire_bytes(self._ts["params"],
-                                                    comp)
+            metered = self._ts["params"]
+            if sparse_paths:
+                # sparse row grads never ride the dense collective — the
+                # wire meter covers the dense leaves only
+                metered = emb_lib.split_sparse(metered)[0]
+            self._grad_bytes_step = grad_wire_bytes(metered, comp)
             self._comm_fn = None  # probe (re)compiles against this mesh
 
     def _measure_comm_ms(self) -> Optional[float]:
@@ -722,8 +835,12 @@ class ZooEstimator:
         comp = self.grad_compression
         if self._comm_fn is None:
             s = batch_shard_count(mesh)
+            probe_params = self._ts["params"]
+            if self._sparse_paths:
+                from analytics_zoo_tpu.parallel import embedding as emb_lib
+                probe_params = emb_lib.split_sparse(probe_params)[0]
             shapes = [tuple(p.shape) for p in
-                      jax.tree_util.tree_leaves(self._ts["params"])]
+                      jax.tree_util.tree_leaves(probe_params)]
 
             def probe(t):
                 tree = [jax.lax.with_sharding_constraint(
@@ -1326,8 +1443,14 @@ class ZooEstimator:
                 lambda l: place(l, P()), tree["params"])
         # checkpoint IO stores optax named-tuples as plain tuples; rebuild the
         # real structure (and its shardings) from tx.init and pour leaves in
-        self._wrap_frozen_tx(tree["params"])
-        ref_opt = _ensure_on_mesh(jax.jit(self.tx.init)(params), mesh)
+        from analytics_zoo_tpu.parallel import embedding as emb_lib
+        self._sparse_paths = emb_lib.sparse_paths(params)
+        self._check_sparse_support()
+        dense_of = (lambda p: emb_lib.split_sparse(p)[0]) \
+            if self._sparse_paths else (lambda p: p)
+        self._wrap_frozen_tx(dense_of(tree["params"]))
+        ref_opt = _ensure_on_mesh(jax.jit(self.tx.init)(dense_of(params)),
+                                  mesh)
         ref_leaves, ref_def = jax.tree_util.tree_flatten(ref_opt)
         saved_leaves = jax.tree_util.tree_leaves(tree["opt_state"])
         if len(saved_leaves) == len(ref_leaves):

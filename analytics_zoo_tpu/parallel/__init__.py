@@ -13,6 +13,8 @@ natural:
   (shard_map + ppermute ring; SURVEY.md §5.7 'post-parity stretch').
 - :mod:`moe` — mixture-of-experts layer, experts sharded over ``expert``.
 - :mod:`pipeline` — GPipe-style pipeline parallelism over the ``pipe`` axis.
+- :mod:`embedding` — device-partitioned embedding tables with deduped
+  gather and sparse scatter-add gradients (the recsys sparse path).
 """
 
 from .sharding import (ShardingRule, infer_param_specs, shard_variables,
@@ -22,6 +24,8 @@ from .moe import MoE
 from .pipeline import pipeline_apply, stacked_stage_init
 from .util import (GRAD_COMPRESSION, batch_shard_count, batch_shard_spec,
                    compressed_allreduce, grad_wire_bytes, quantize_int8)
+from .embedding import (ShardedEmbedding, dedup_lookup, embedding_row_rules,
+                        lookup_stats)
 
 __all__ = [
     "ShardingRule", "infer_param_specs", "shard_variables",
@@ -30,4 +34,6 @@ __all__ = [
     "MoE", "pipeline_apply", "stacked_stage_init",
     "GRAD_COMPRESSION", "batch_shard_count", "batch_shard_spec",
     "compressed_allreduce", "grad_wire_bytes", "quantize_int8",
+    "ShardedEmbedding", "dedup_lookup", "embedding_row_rules",
+    "lookup_stats",
 ]

@@ -16,9 +16,11 @@ from .server import ClusterServing
 from .client import InputQueue, OutputQueue, RetryPolicy
 from .router import CircuitBreaker, ReplicaSet
 from .http_frontend import HTTPFrontend
+from .embed_cache import CachedEmbeddingModel, EmbedCache
 
 __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
            "InputQueue", "OutputQueue", "RetryPolicy",
            "CircuitBreaker", "ReplicaSet",
            "HTTPFrontend", "ModelRegistry",
-           "Scheduler", "WindowScheduler", "ContinuousScheduler"]
+           "Scheduler", "WindowScheduler", "ContinuousScheduler",
+           "EmbedCache", "CachedEmbeddingModel"]

@@ -100,6 +100,12 @@ class ModelRegistry:
         # metric series here, so refresh-style swaps (monotone v1, v2,
         # ... version strings) don't grow the scrape without bound
         self._unload_hooks: List[Any] = []
+        # swap observers (fn(name, old_version, new_version), called
+        # right after the atomic flip, before the drain): serving-side
+        # caches keyed by (model, version) invalidate here, so a
+        # hot-swapped version can never serve rows cached from its
+        # predecessor
+        self._swap_hooks: List[Any] = []
 
     def on_unload(self, fn: Any) -> None:
         """Register ``fn(name, version)`` to run after a version is
@@ -113,6 +119,21 @@ class ModelRegistry:
         retain every stopped server."""
         try:
             self._unload_hooks.remove(fn)
+        except ValueError:
+            pass
+
+    def on_swap(self, fn: Any) -> None:
+        """Register ``fn(name, old_version, new_version)`` to run right
+        after a ``swap()``'s atomic flip (before the old version drains).
+        ``serving.EmbedCache.attach`` subscribes here to drop the
+        outgoing version's cached rows the moment it stops being
+        active."""
+        self._swap_hooks.append(fn)
+
+    def off_swap(self, fn: Any) -> None:
+        """Deregister an ``on_swap`` observer (no-op when absent)."""
+        try:
+            self._swap_hooks.remove(fn)
         except ValueError:
             pass
 
@@ -385,6 +406,11 @@ class ModelRegistry:
             self._m_swaps.inc()
             logger.info("model %s: active version %s -> %s", name,
                         old_ver, version)
+            # observers see the flip before the drain: anything cached
+            # against the outgoing version is stale the moment requests
+            # can no longer be assembled against it
+            for fn in list(self._swap_hooks):
+                fn(name, old_ver, version)
             if drain and old_ver is not None and old_ver != version:
                 if not self.drain_version(name, old_ver,
                                           timeout=drain_timeout):

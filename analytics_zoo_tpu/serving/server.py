@@ -260,6 +260,7 @@ class ClusterServing:
                                   None] = None,
                  models: Union[ModelRegistry, Dict[str, Any],
                                None] = None,
+                 pipelines: Optional[Dict[str, Any]] = None,
                  faults: Optional[FaultRegistry] = None,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None):
         """``inference_workers``: concurrent model-call threads pulling
@@ -288,8 +289,16 @@ class ClusterServing:
         dict.  Requests route by their ``model`` header field (and an
         optional ``version`` pin); ``model`` (the positional arg) is
         additionally registered under the name ``"default"`` and serves
-        requests that name no model."""
+        requests that name no model.
+
+        ``pipelines``: ``{model_name: callable}`` server-side feature
+        transforms, applied to the assembled batch (``fn(x) -> x'``)
+        right before that model's ``predict`` — e.g. a fitted
+        ``friesian.FeaturePipeline.as_server_transform(...)`` turning
+        raw event columns into the model's numeric features, so clients
+        send raw events instead of shipping the feature recipe."""
         self._metrics = metrics or metrics_lib.get_registry()
+        self.pipelines = dict(pipelines or {})
         self.registry = ModelRegistry.ensure(models,
                                              metrics=self._metrics)
         if model is not None:
@@ -1233,6 +1242,12 @@ class ClusterServing:
         m_bs.observe(len(group))
         t_inf = time.monotonic()
         try:
+            pipe = self.pipelines.get(ab.model or self._default_name)
+            if pipe is not None:
+                # registered feature transform: raw event columns in,
+                # model-ready features out (counts toward inference_ms —
+                # it is per-request serving compute either way)
+                x = pipe(x)
             out = np.asarray(ab.im.predict(x))
             infer_ms = (time.monotonic() - t_inf) * 1000.0
             if np.may_share_memory(out, x):

@@ -1,0 +1,271 @@
+"""Hot-row embedding cache + end-to-end recsys serving: EmbedCache LRU
+semantics, swap-driven invalidation, the CachedEmbeddingModel adapter,
+and raw events -> FeaturePipeline -> sharded NCF behind ClusterServing ->
+ranked top-k, including a hot swap under load."""
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core import init_orca_context, metrics
+from analytics_zoo_tpu.friesian import FeaturePipeline, StringIndex
+from analytics_zoo_tpu.models import NeuralCF
+from analytics_zoo_tpu.orca.learn import Estimator
+from analytics_zoo_tpu.serving import (CachedEmbeddingModel, ClusterServing,
+                                       EmbedCache, InferenceModel,
+                                       InputQueue, ModelRegistry,
+                                       OutputQueue)
+
+USERS, ITEMS = 64, 40
+
+
+@pytest.fixture(scope="module")
+def recsys_parts():
+    """One small sharded-embedding NCF, trained once and split for
+    serving: (host tables, tail-input column spec, loaded tail, a probe
+    request, and the full-model logits for that request's pairs)."""
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    n = 512
+    x = np.stack([rng.integers(0, USERS, n),
+                  rng.integers(0, ITEMS, n)], 1).astype(np.int32)
+    y = (rng.random(n) < 0.5).astype(np.int32)
+    ncf = NeuralCF(user_count=USERS, item_count=ITEMS, class_num=2,
+                   user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                   mf_embed=8, sharded_embeddings=True)
+    est = Estimator.from_keras(ncf, loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-2, seed=3)
+    est.fit((x, y), epochs=1, batch_size=64, verbose=False)
+    tables, tail_mod, tail_vars = ncf.serving_split(
+        {"params": est._ts["params"]})
+    im = InferenceModel().load(tail_mod, tail_vars)
+    req = np.array([[3, 1, 2, 5, 7]], np.int64)  # user 3, 4 candidates
+    pairs = np.stack([np.full(4, 3), np.array([1, 2, 5, 7])],
+                     1).astype(np.int32)
+    logits = np.asarray(est.predict(pairs, batch_size=4))
+    return {"tables": tables, "columns": ncf.embedding_columns(),
+            "im": im, "req": req, "logits": logits}
+
+
+def _rank_from_logits(logits, items):
+    z = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    pos = 1.0 - p[:, 0] / p.sum(axis=-1)
+    return items[np.argsort(-pos, kind="stable")]
+
+
+# -- EmbedCache ---------------------------------------------------------------
+
+def test_embed_cache_lru_eviction_and_metrics():
+    reg = metrics.get_registry()
+    c = EmbedCache(capacity=3)
+    c.insert("m", "v1", "t", [1, 2, 3], np.eye(3, 4, dtype=np.float32))
+    hits, missing = c.lookup("m", "v1", "t", [1, 9])
+    assert list(hits) == [1] and missing == [9]
+    # id 1 was refreshed: inserting two more evicts 2 then 3, not 1
+    c.insert("m", "v1", "t", [4, 5], np.zeros((2, 4), np.float32))
+    assert len(c) == 3
+    hits, missing = c.lookup("m", "v1", "t", [1, 2, 3, 4, 5])
+    assert sorted(hits) == [1, 4, 5] and missing == [2, 3]
+    snap = reg.snapshot()
+    assert snap["embed.cache_hits"] == 1 + 3
+    assert snap["embed.cache_misses"] == 1 + 2
+    assert snap["embed.cache_evictions"] == 2
+    assert snap["embed.cache_size"]["value"] == 3
+    with pytest.raises(ValueError, match="capacity"):
+        EmbedCache(capacity=0)
+
+
+def test_embed_cache_invalidate_scopes():
+    c = EmbedCache(capacity=100)
+    for model, ver in [("a", "v1"), ("a", "v2"), ("b", "v1")]:
+        c.insert(model, ver, "t", [0, 1], np.zeros((2, 2), np.float32))
+    assert c.invalidate("a", "v1") == 2
+    assert len(c) == 4
+    assert c.invalidate("a") == 2          # all remaining versions of a
+    assert c.invalidate() == 2             # whole cache
+    assert len(c) == 0
+    assert metrics.get_registry().snapshot()["embed.cache_size"]["value"] == 0
+
+
+def test_embed_cache_attach_swap_and_unload_invalidation():
+    class _Stub:
+        def predict(self, x):
+            return np.asarray(x)
+
+    c = EmbedCache(capacity=100)
+    reg = ModelRegistry()
+    c.attach(reg)
+    reg.register("m", _Stub(), version="v1")
+    c.insert("m", "v1", "t", [0, 1, 2], np.zeros((3, 2), np.float32))
+    c.insert("other", "v1", "t", [0], np.zeros((1, 2), np.float32))
+    reg.swap("m", _Stub(), version="v2", warm=False)
+    # the flip dropped v1's rows; unrelated models keep theirs
+    assert c.invalidate("m", "v1") == 0
+    assert len(c) == 1
+    c.insert("m", "v2", "t", [5], np.zeros((1, 2), np.float32))
+    reg.swap("m", _Stub(), version="v3", warm=False, keep_old=False)
+    assert c.invalidate("m", "v2") == 0    # swap AND unload both fired
+    c.detach(reg)
+    c.insert("m", "v3", "t", [7], np.zeros((1, 2), np.float32))
+    reg.swap("m", _Stub(), version="v4", warm=False)
+    assert c.invalidate("m", "v3") == 1    # detached: nothing auto-dropped
+
+
+# -- CachedEmbeddingModel -----------------------------------------------------
+
+def test_cached_adapter_ranks_like_full_model(recsys_parts):
+    p = recsys_parts
+    adapter = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                                   cache=EmbedCache(capacity=1000))
+    ranked = adapter.predict(p["req"])
+    expect = _rank_from_logits(p["logits"], p["req"][0, 1:])
+    np.testing.assert_array_equal(ranked[0], expect)
+
+
+def test_cached_adapter_meters_hits_and_dedup(recsys_parts):
+    p = recsys_parts
+    reg = metrics.get_registry()
+    adapter = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                                   cache=EmbedCache(capacity=1000))
+    adapter.predict(p["req"])
+    snap = reg.snapshot()
+    # 4 tables x (1 unique user or 4 unique items): all cold misses
+    assert snap["embed.cache_misses"] == 10
+    assert snap["embed.cache_hits"] == 0
+    # dedup accounting: user column repeats 4x per pair
+    assert snap["embed.gather_rows"] < snap["embed.gather_rows_naive"]
+    adapter.predict(p["req"])              # same request: all hot
+    snap = reg.snapshot()
+    assert snap["embed.cache_misses"] == 10
+    assert snap["embed.cache_hits"] == 10
+
+
+def test_cached_adapter_without_cache_and_input_validation(recsys_parts):
+    p = recsys_parts
+    plain = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                                 cache=None)
+    cached = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                                  cache=EmbedCache(capacity=1000))
+    np.testing.assert_array_equal(plain.predict(p["req"]),
+                                  cached.predict(p["req"]))
+    with pytest.raises(ValueError, match="user"):
+        CachedEmbeddingModel(p["tables"], [("t", "timestamp")], p["im"])
+    with pytest.raises(ValueError, match=r"\[B, 1 \+ k\]"):
+        plain.predict(np.array([3], np.int64))
+
+
+# -- end-to-end: events in, ranked ids out ------------------------------------
+
+def _event_pipeline(k):
+    uix = {f"u{i}": i for i in range(1, USERS)}
+    iix = {f"i{i}": i for i in range(1, ITEMS)}
+    pipe = (FeaturePipeline().encode_string(StringIndex("user", uix))
+            .encode_string(StringIndex("item", iix)))
+    return pipe, pipe.as_server_transform(["user"] + ["item"] * k,
+                                          dtype=np.int64)
+
+
+def test_server_pipeline_raw_events_to_ranked_ids(recsys_parts):
+    """ClusterServing(pipelines=): clients send raw string events; the
+    registered FeaturePipeline encodes them server-side and the reply is
+    the ranked candidate ids."""
+    p = recsys_parts
+    adapter = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                                   cache=EmbedCache(capacity=1000))
+    pipe, tf = _event_pipeline(k=4)
+    # pipelines survive pickling (ship with server config)
+    tf = pickle.loads(pickle.dumps(tf))
+    with ClusterServing(models={"recsys": adapter},
+                        pipelines={"recsys": tf},
+                        batch_size=4, batch_timeout_ms=2) as srv:
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        ev = np.array(["u3", "i1", "i2", "i5", "i7"], dtype="<U8")
+        out = oq.query(iq.enqueue("c0", model="recsys", t=ev),
+                       timeout=30.0)
+        iq.close()
+    expect = _rank_from_logits(p["logits"], p["req"][0, 1:])
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_hot_swap_under_load_zero_stale_rows_zero_failures(recsys_parts):
+    """The acceptance path: raw events flow while the model hot-swaps.
+    Every reply must be EXACTLY one version's ranking (a stale cached
+    row would blend versions and produce a third ordering), no request
+    may fail, and the flip must drop the outgoing version's cache rows."""
+    p = recsys_parts
+    cache = EmbedCache(capacity=10_000)
+    v1 = CachedEmbeddingModel(p["tables"], p["columns"], p["im"],
+                              cache=cache, version="v1")
+    tables2 = {name: -np.asarray(t) for name, t in p["tables"].items()}
+    v2 = CachedEmbeddingModel(tables2, p["columns"], p["im"],
+                              cache=cache, version="v2")
+    # uncached references for the two expected rankings
+    expect_v1 = CachedEmbeddingModel(
+        p["tables"], p["columns"], p["im"]).predict(p["req"])[0]
+    expect_v2 = CachedEmbeddingModel(
+        tables2, p["columns"], p["im"]).predict(p["req"])[0]
+    assert not np.array_equal(expect_v1, expect_v2)
+
+    reg = ModelRegistry()
+    cache.attach(reg)
+    reg.register("recsys", v1, version="v1")
+    _, tf = _event_pipeline(k=4)
+    ev = np.array(["u3", "i1", "i2", "i5", "i7"], dtype="<U8")
+    replies, errors = [], []
+    stop = threading.Event()
+
+    def client():
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        i = 0
+        try:
+            while not stop.is_set() and i < 400:
+                uid = iq.enqueue(f"r{i}", model="recsys", t=ev)
+                replies.append(np.asarray(oq.query(uid, timeout=30.0)))
+                i += 1
+        except Exception as e:  # noqa: BLE001 - any failure fails the test
+            errors.append(e)
+        finally:
+            iq.close()
+
+    with ClusterServing(models=reg, pipelines={"recsys": tf},
+                        batch_size=4, batch_timeout_ms=2,
+                        inference_workers=2) as srv:
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # let v1 serve (and populate the cache), then flip under load
+        deadline = 30.0
+        import time
+        t0 = time.monotonic()
+        while not replies and time.monotonic() - t0 < deadline:
+            time.sleep(0.01)
+        reg.swap("recsys", v2, version="v2", warm=False)
+        # keep serving until v2 rankings flow
+        while (not any(np.array_equal(r, expect_v2) for r in replies[-6:])
+               and time.monotonic() - t0 < deadline and not errors):
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        # a post-swap request must rank with v2 rows only
+        iq = InputQueue(srv.host, srv.port)
+        oq = OutputQueue(input_queue=iq)
+        final = np.asarray(oq.query(iq.enqueue("fin", model="recsys",
+                                               t=ev), timeout=30.0))
+        iq.close()
+
+    assert not errors, errors
+    assert replies
+    bad = [r for r in replies
+           if not (np.array_equal(r, expect_v1)
+                   or np.array_equal(r, expect_v2))]
+    assert not bad, f"stale/blended rankings: {bad[:3]}"
+    np.testing.assert_array_equal(final, expect_v2)
+    assert any(np.array_equal(r, expect_v2) for r in replies)
+    # the flip dropped every v1 row at swap time
+    assert cache.invalidate("recsys", "v1") == 0

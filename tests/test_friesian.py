@@ -145,3 +145,123 @@ def test_cross_columns_feed_wide_and_deep():
     hist = est.fit((x, y), epochs=2, batch_size=32, verbose=False)
     assert np.isfinite(hist["loss"][-1])
     assert est.predict(x, batch_size=32).shape == (n, 2)
+
+
+# -- determinism & shard invariance (sharded-embedding recsys path) ----------
+
+def test_cross_hash_is_fixed_fnv_not_process_salted():
+    """Hashed crosses must be reproducible across processes and releases:
+    hard-coded FNV-1a regression values, NOT python's salted hash()."""
+    from analytics_zoo_tpu.friesian.table import _stable_hash
+    assert _stable_hash("u1_i1") == 4595758986926148594
+    assert _stable_hash("u2_i3") == 1669683716010366719
+    assert _stable_hash("a_b_c") == 2048235475453274411
+    df = pd.DataFrame({"user": ["u1", "u2"], "item": ["i1", "i3"]})
+    out = FeatureTable.from_pandas(df, num_shards=1) \
+        .cross_columns([("user", "item")], [16]).to_pandas()
+    assert list(out["user_item"]) == [2, 15]
+
+
+def test_negative_sample_seed_reproducible_and_seed_sensitive():
+    df = _ratings_df(n=48)
+    tbl = FeatureTable.from_pandas(df)
+    enc, idxs = tbl.encode_string(["user", "item"])
+    size = idxs[1].size
+    a = enc.negative_sample(size, item_col="item", neg_num=2,
+                            seed=11).to_pandas()
+    b = enc.negative_sample(size, item_col="item", neg_num=2,
+                            seed=11).to_pandas()
+    c = enc.negative_sample(size, item_col="item", neg_num=2,
+                            seed=12).to_pandas()
+    pd.testing.assert_frame_equal(a, b)
+    assert not a["item"].equals(c["item"])
+
+
+def test_negative_sample_invariant_to_shard_count():
+    """The same rows with the same seed must draw the same negatives on
+    1 shard and on 4 (counter-based sampling keyed on GLOBAL row
+    position): the 1-shard debug run reproduces the sharded job."""
+    df = _ratings_df(n=60)
+    outs = []
+    for shards in (1, 4):
+        tbl = FeatureTable.from_pandas(df, num_shards=shards)
+        enc, idxs = tbl.encode_string(
+            ["user", "item"],
+            indices=FeatureTable.from_pandas(df, num_shards=1)
+            .gen_string_idx(["user", "item"]))
+        out = enc.negative_sample(idxs[1].size, item_col="item",
+                                  neg_num=2, seed=5).to_pandas()
+        outs.append(out.sort_values(list(out.columns))
+                    .reset_index(drop=True))
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+
+
+def test_negative_sample_rejects_tiny_item_space():
+    tbl = FeatureTable.from_pandas(pd.DataFrame({"item": [1], "x": [0]}))
+    with pytest.raises(ValueError, match="item_size"):
+        tbl.negative_sample(item_size=1, item_col="item")
+
+
+def test_feature_ops_invariant_to_shard_count():
+    """encode/fillna/clip/cross produce identical tables on 1 vs 4
+    shards (vocab building is a global reduce; per-row ops are local)."""
+    df = _ratings_df(n=50)
+    outs = []
+    for shards in (1, 4):
+        tbl = FeatureTable.from_pandas(df, num_shards=shards)
+        t2, _ = tbl.fillna(0.0, ["age"]).clip(["age"], min=0, max=100) \
+            .encode_string(["user", "item"])
+        outs.append(t2.cross_columns([("user", "item")], [50]).to_pandas())
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+
+
+def test_feature_pipeline_matches_feature_table():
+    """FeaturePipeline replays the fitted offline transforms per request
+    with IDENTICAL semantics (same hash, unseen -> 0, same fill/clip)."""
+    from analytics_zoo_tpu.friesian import FeaturePipeline
+    df = _ratings_df(n=40)
+    tbl = FeatureTable.from_pandas(df)
+    idx_u, idx_i = tbl.gen_string_idx(["user", "item"])
+    off, _ = tbl.fillna(0.0, ["age"]).clip(["age"], min=0, max=30) \
+        .encode_string(["user", "item"], [idx_u, idx_i])
+    off = off.cross_columns([("user", "item")], [50]).to_pandas()
+    pipe = (FeaturePipeline().fillna(0.0, ["age"])
+            .clip(["age"], min=0, max=30)
+            .encode_string(idx_u).encode_string(idx_i)
+            .cross_columns([("user", "item")], [50]))
+    ev = pipe.transform([{"user": u, "item": i, "age": a}
+                         for u, i, a in zip(df.user, df.item, df.age)])
+    for col in ("user", "item", "age", "user_item"):
+        np.testing.assert_array_equal(np.asarray(ev[col], np.float64),
+                                      off[col].to_numpy(np.float64))
+
+
+def test_feature_pipeline_pickles_and_maps_unseen_to_zero():
+    import pickle
+    from analytics_zoo_tpu.friesian import FeaturePipeline
+    tbl = FeatureTable.from_pandas(_ratings_df(n=24))
+    idx_u, idx_i = tbl.gen_string_idx(["user", "item"])
+    pipe = (FeaturePipeline().fillna(0.0, ["age"])
+            .encode_string(idx_u).encode_string(idx_i))
+    pipe = pickle.loads(pickle.dumps(pipe))
+    out = pipe.transform({"user": "NEVER_SEEN", "item": "i0",
+                          "age": None})
+    assert out["user"][0] == 0
+    assert out["item"][0] == idx_i.index["i0"]
+    assert out["age"][0] == 0.0
+
+
+def test_feature_pipeline_matrix_layout_and_validation():
+    """transform_matrix: the serving wire layout [B, C] with repeated
+    column names (one user + k item positions), crosses appended."""
+    from analytics_zoo_tpu.friesian import FeaturePipeline
+    idx = StringIndex("item", {"a": 1, "b": 2})
+    pipe = FeaturePipeline().encode_string(idx)
+    x = np.array([["a", "b", "zz"]], dtype=object)
+    out = pipe.transform_matrix(x, ["item", "item", "item"],
+                                dtype=np.int64)
+    np.testing.assert_array_equal(out, [[1, 2, 0]])
+    with pytest.raises(ValueError, match="column"):
+        pipe.transform_matrix(x, ["item"])
+    with pytest.raises(ValueError, match="bucket size"):
+        FeaturePipeline().cross_columns([("a", "b")], [4, 5])
