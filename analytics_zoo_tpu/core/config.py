@@ -183,6 +183,33 @@ class ZooConfig:
     # ModelRegistry; in code, pass ClusterServing(models=...) directly
     models: Optional[Dict[str, str]] = None
 
+    # per-class admission (serving/server.py, ISSUE 12): requests tagged
+    # klass="batch" face a TIGHTER admission gate than interactive /
+    # unclassified traffic, so overload sheds batch first.  The wait
+    # margin multiplies the queue-wait EWMA in the deadline
+    # attainability check (2.0 = a batch request needs 2x the current
+    # wait of headroom); the depth fraction scales the queue-depth
+    # limit (0.5 = batch is rejected once the queue is half full).
+    # 1.0/1.0 restores classless admission for every class.
+    admission_batch_wait_margin: float = 2.0
+    admission_batch_depth_frac: float = 0.5
+
+    # serving control plane (serving/controller.py, ISSUE 12): the
+    # autoscaler knobs behind `zoo-serving --autoscale` and
+    # ServingController's default HysteresisPolicy.  The SLO is on the
+    # per-tick windowed client p99; replicas bounds bracket the pool.
+    controller_slo_p99_ms: float = 100.0
+    controller_min_replicas: int = 1
+    controller_max_replicas: int = 4
+    controller_interval_s: float = 1.0
+    # scale-UP queue high-water mark (None = p99-only policy) and the
+    # up/down cooldowns + consecutive-calm-tick requirement guarding
+    # scale-down (hysteresis: a noisy minute never flaps the pool)
+    controller_queue_high: Optional[float] = None
+    controller_up_cooldown_s: float = 5.0
+    controller_down_cooldown_s: float = 30.0
+    controller_down_ticks: int = 3
+
     # logging / summaries (reference: set_tensorboard, TrainSummary)
     log_dir: str = "/tmp/analytics_zoo_tpu"
     log_level: str = "INFO"

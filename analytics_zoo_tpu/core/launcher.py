@@ -129,6 +129,50 @@ def _terminate_gang(procs: List[subprocess.Popen], grace: float) -> None:
             p.wait()
 
 
+def launch_serving_replica(extra_args: List[str],
+                           host: str = "127.0.0.1",
+                           port: Optional[int] = None,
+                           env: Optional[Dict[str, str]] = None,
+                           ) -> Tuple[subprocess.Popen, int]:
+    """Spawn ONE ``zoo-serving`` child on this machine — the
+    ``ServingController``'s subprocess scale-up actuation (ISSUE 12).
+    ``extra_args`` is the model/config tail of the child's command line
+    (``--model-dir ...`` etc.); host/port are prepended here so the
+    caller controls the address.  Returns ``(proc, port)``; pair with
+    :func:`wait_serving_ready` before routing traffic at it."""
+    if port is None:
+        port = _free_port()
+    cmd = [sys.executable, "-m", "analytics_zoo_tpu.serving.server",
+           "--host", host, "--port", str(port)] + list(extra_args)
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    proc = subprocess.Popen(cmd, env=child_env)
+    logger.info("launched serving replica pid=%d on %s:%d", proc.pid,
+                host, port)
+    return proc, port
+
+
+def wait_serving_ready(host: str, port: int,
+                       proc: Optional[subprocess.Popen] = None,
+                       timeout: float = 60.0,
+                       interval: float = 0.1) -> bool:
+    """Poll until the replica accepts TCP connections (the CLI loads —
+    and thereby warms — its model before binding, so accepting implies
+    warm).  Bails out early when ``proc`` already exited: a crashed
+    child must not cost the full timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(interval)
+    return False
+
+
 def _read_heartbeat_payload(path: Optional[str]) -> dict:
     """The worker's last JSON status payload (context._Heartbeat), or {}
     for a missing/empty/legacy-touch heartbeat file.  Tolerant by

@@ -227,6 +227,13 @@ class Histogram:
             counts = list(self.counts)
         return _bucket_percentile(self.edges, counts, q)
 
+    def quantile(self, q: float) -> float:
+        """Public q-quantile accessor (q in [0, 1]) — the name control
+        loops use (``percentile`` predates it and stays as an alias).
+        Lifetime distribution; pair with :func:`snapshot_delta` +
+        :func:`quantile_from_snapshot` for a recent-window quantile."""
+        return self.percentile(q)
+
     def _reset(self) -> None:
         with self._lock:
             self.counts = [0] * (len(self.edges) + 1)
@@ -550,6 +557,71 @@ class MetricsRegistry:
                 with c._lock:
                     c.value = val
         return reg
+
+
+def snapshot_delta(prev: Dict[str, Any],
+                   cur: Dict[str, Any]) -> Dict[str, Any]:
+    """The WINDOW between two ``snapshot()`` dicts — what changed since
+    ``prev`` was taken.  Control loops need *recent* behavior (the p99
+    of the last control tick, the requests admitted since the last
+    decision), and lifetime distributions answer a different question:
+    an hour of calm traffic drowns a 10-second latency spike that
+    should trigger a scale-up.
+
+    Per series:
+
+    - **counters** subtract (``cur - prev``; a series absent from
+      ``prev`` — e.g. first tick — contributes its full value);
+    - **gauges** pass through ``cur`` (a point-in-time value has no
+      meaningful delta; the high-water ``max`` stays lifetime);
+    - **histograms** subtract bucket counts / count / sum, with
+      p50/p99/mean recomputed from the WINDOW's buckets.  On a bucket-
+      edge mismatch (a series re-registered with different buckets
+      between ticks) the current snapshot passes through untouched.
+
+    Series that vanished between snapshots (``remove()``d) are absent
+    from the delta.  Counter resets between ticks (``reset()``) clamp
+    to the current value rather than going negative."""
+    out: Dict[str, Any] = {}
+    for series, val in cur.items():
+        old = prev.get(series)
+        if isinstance(val, dict) and "count" in val:  # histogram
+            if (old is None or "count" not in old
+                    or list(old.get("bucket_edges") or ())
+                    != list(val.get("bucket_edges") or ())):
+                out[series] = dict(val)
+                continue
+            edges = tuple(val["bucket_edges"])
+            counts = [max(0, c - p) for c, p in
+                      zip(val["bucket_counts"], old["bucket_counts"])]
+            count = max(0, val["count"] - old["count"])
+            total = max(0.0, round(val["sum"] - old["sum"], 6))
+            out[series] = {
+                "count": count, "sum": total,
+                "mean": round(total / count, 6) if count else 0.0,
+                "p50": round(_bucket_percentile(edges, counts, 0.50), 6),
+                "p99": round(_bucket_percentile(edges, counts, 0.99), 6),
+                "bucket_edges": list(edges),
+                "bucket_counts": counts}
+        elif isinstance(val, dict):  # gauge: point-in-time, no delta
+            out[series] = dict(val)
+        else:  # counter
+            out[series] = (val if not isinstance(old, (int, float))
+                           else max(0, val - old))
+    return out
+
+
+def quantile_from_snapshot(val: Any, q: float) -> Optional[float]:
+    """q-quantile of one snapshot entry's histogram — works on the
+    dicts ``snapshot()`` / ``snapshot_delta`` / ``merge`` produce, so a
+    controller can read a windowed p99 without materializing a registry.
+    None when the entry is not a histogram, carries no buckets (edge-
+    mismatch merge), or observed nothing."""
+    if (not isinstance(val, dict) or "bucket_counts" not in val
+            or not val.get("count")):
+        return None
+    return _bucket_percentile(tuple(val["bucket_edges"]),
+                              val["bucket_counts"], q)
 
 
 def _merge_hist(cur: Dict[str, Any], val: Dict[str, Any]) -> None:

@@ -17,10 +17,16 @@ from .client import InputQueue, OutputQueue, RetryPolicy
 from .router import CircuitBreaker, ReplicaSet
 from .http_frontend import HTTPFrontend
 from .embed_cache import CachedEmbeddingModel, EmbedCache
+from .controller import (HysteresisPolicy, InProcessReplicaFactory,
+                         ReplicaFactory, ReplicaHandle, ScalingPolicy,
+                         ServingController, SubprocessReplicaFactory)
 
 __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
            "InputQueue", "OutputQueue", "RetryPolicy",
            "CircuitBreaker", "ReplicaSet",
            "HTTPFrontend", "ModelRegistry",
            "Scheduler", "WindowScheduler", "ContinuousScheduler",
-           "EmbedCache", "CachedEmbeddingModel"]
+           "EmbedCache", "CachedEmbeddingModel",
+           "ServingController", "ScalingPolicy", "HysteresisPolicy",
+           "ReplicaFactory", "ReplicaHandle", "InProcessReplicaFactory",
+           "SubprocessReplicaFactory"]

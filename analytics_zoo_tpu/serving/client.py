@@ -438,6 +438,7 @@ class InputQueue:
                 trace_id: Optional[str] = None, uid: Optional[str] = None,
                 model: Optional[str] = None,
                 version: Optional[str] = None,
+                klass: Optional[str] = None,
                 **kwargs: np.ndarray) -> str:
         """Send one named tensor; returns the uuid to ``query`` on.
 
@@ -463,7 +464,13 @@ class InputQueue:
         (``ClusterServing(models=...)``, serving/model_registry.py);
         omitted = the server's default model's active version.  An
         unroutable pair gets a non-retryable error reply (``query``
-        raises)."""
+        raises).
+
+        ``klass``: request class (``"interactive"`` | ``"batch"``) for
+        the server's per-class admission gate — under pressure batch
+        traffic is shed first so interactive traffic holds its SLO.
+        Omitted = unclassified (the frame is byte-identical to a
+        pre-klass client's)."""
         if len(kwargs) != 1:
             raise ValueError("exactly one named tensor per enqueue "
                              "(reference: t=ndarray)")
@@ -476,7 +483,8 @@ class InputQueue:
             span=trace_lib.new_span_id() if trace_lib.enabled else None,
             model=model, version=version,
             deadline_ms=(max(1, int(deadline * 1000))
-                         if deadline is not None else None))
+                         if deadline is not None else None),
+            klass=klass)
         self._conn.send_request(header, np.asarray(arr))
         return uid
 

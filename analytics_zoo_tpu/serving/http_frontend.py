@@ -240,6 +240,9 @@ class HTTPFrontend:
                     # request body; absent = the backend's default model
                     model = req.get("model")
                     version = req.get("version")
+                    # per-class admission: "interactive" | "batch" —
+                    # under pressure the backend sheds batch first
+                    klass = req.get("klass")
                 except (KeyError, ValueError, TypeError) as e:
                     frontend._bump("errors")
                     self._json(400, {"error": f"bad request: {e}"},
@@ -248,7 +251,7 @@ class HTTPFrontend:
                 try:
                     out = frontend.predict(arr, deadline=deadline,
                                            trace_id=tid, model=model,
-                                           version=version)
+                                           version=version, klass=klass)
                 except RuntimeError as e:  # serving-side error reply
                     if ("unknown model" in str(e)
                             or "unknown version" in str(e)
@@ -378,7 +381,8 @@ class HTTPFrontend:
                 deadline: Optional[float] = None,
                 trace_id: Optional[str] = None,
                 model: Optional[str] = None,
-                version: Optional[str] = None) -> Optional[np.ndarray]:
+                version: Optional[str] = None,
+                klass: Optional[str] = None) -> Optional[np.ndarray]:
         """One request through the replica set.  Least-pending routing,
         retry-on-other-replica failover, circuit breaking, reconnect
         with backoff and idempotent re-enqueue all live underneath
@@ -394,7 +398,7 @@ class HTTPFrontend:
         # timeout as the 504 reason
         return self._router.predict(arr, deadline=deadline,
                                     trace_id=trace_id, model=model,
-                                    version=version)
+                                    version=version, klass=klass)
 
     # -- lifecycle ------------------------------------------------------------
 

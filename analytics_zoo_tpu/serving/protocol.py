@@ -65,11 +65,18 @@ def encode_metrics_request(uid: str) -> bytes:
     return encode({"uuid": uid, "type": METRICS})
 
 
+#: Request classes the per-class admission gate understands.  Requests
+#: carrying any other value (or none) are treated as unclassified —
+#: admitted exactly like pre-klass traffic.
+KLASSES = ("interactive", "batch")
+
+
 def request_header(uid: str, trace: Optional[str] = None,
                    span: Optional[str] = None,
                    model: Optional[str] = None,
                    version: Optional[str] = None,
-                   deadline_ms: Optional[int] = None) -> Dict[str, Any]:
+                   deadline_ms: Optional[int] = None,
+                   klass: Optional[str] = None) -> Dict[str, Any]:
     """The standard request header.  All fields beyond ``uuid`` are
     OPTIONAL and absent fields are simply omitted from the wire, so a
     pre-multi-model client's frames are unchanged byte for byte:
@@ -85,7 +92,11 @@ def request_header(uid: str, trace: Optional[str] = None,
     - ``version``: pin a specific loaded version of that model (canary
       reads across a hot swap); absent = the model's ACTIVE version at
       batch-assembly time;
-    - ``deadline_ms``: relative latency budget, re-anchored server-side.
+    - ``deadline_ms``: relative latency budget, re-anchored server-side;
+    - ``klass``: request class for per-class admission
+      (``"interactive"`` | ``"batch"``): under pressure the server sheds
+      batch-class requests first so interactive traffic holds its SLO.
+      Absent = unclassified (admitted like pre-klass traffic).
     """
     header: Dict[str, Any] = {"uuid": uid}
     if trace is not None:
@@ -98,6 +109,8 @@ def request_header(uid: str, trace: Optional[str] = None,
         header["version"] = str(version)
     if deadline_ms is not None:
         header["deadline_ms"] = int(deadline_ms)
+    if klass is not None:
+        header["klass"] = str(klass)
     return header
 
 Frame = Union[bytes, bytearray]

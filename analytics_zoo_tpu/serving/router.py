@@ -204,7 +204,8 @@ class Replica:
                 deadline: Optional[float], trace_id: str,
                 model: Optional[str] = None,
                 version: Optional[str] = None,
-                parent_span: Optional[str] = None) -> None:
+                parent_span: Optional[str] = None,
+                klass: Optional[str] = None) -> None:
         """Send one request under an EXPLICIT uuid (failover and hedging
         re-enqueue the same uuid on another replica — the idempotency
         contract from PR 1, stretched across backends).  ``model`` /
@@ -220,7 +221,8 @@ class Replica:
         header = protocol.request_header(
             uid, trace=trace_id, span=sid, model=model, version=version,
             deadline_ms=(max(1, int(deadline * 1000))
-                         if deadline is not None else None))
+                         if deadline is not None else None),
+            klass=klass)
         self.conn.send_request(header, np.asarray(arr))
 
     def forget(self, uid: str
@@ -261,12 +263,25 @@ class ReplicaSet:
                  health_interval: float = 0.25,
                  health_timeout: float = 1.0,
                  unhealthy_after: int = 2,
-                 hedge_ms: Optional[float] = None,
+                 hedge_ms: Union[float, str, None] = None,
+                 hedge_quantile: float = 0.95,
+                 hedge_margin_ms: float = 5.0,
+                 hedge_min_ms: float = 1.0,
+                 hedge_max_ms: float = 1000.0,
+                 hedge_min_samples: int = 20,
                  metrics: Optional[metrics_lib.MetricsRegistry] = None,
                  start_health: bool = True):
         """``hedge_ms``: enable hedged reads — a deadline'd request that
         has waited this long without a reply is re-enqueued (same uuid)
         on a second replica, first answer wins.  None (default) = off.
+        ``"auto"`` = self-tuning: each :meth:`retune_hedge` call (the
+        controller runs one per control tick) re-derives the threshold
+        from the RECENT ``client.request_ms`` distribution —
+        ``hedge_quantile`` of the window plus ``hedge_margin_ms``,
+        clamped to [``hedge_min_ms``, ``hedge_max_ms``]; windows with
+        fewer than ``hedge_min_samples`` observations are accumulated
+        instead of acted on (a quiet tick must not swing the threshold),
+        and hedging stays OFF until the first tuned value exists.
 
         ``unhealthy_after``: consecutive failed pings before a replica
         is ejected from rotation (it keeps being probed and returns on
@@ -278,7 +293,21 @@ class ReplicaSet:
         self.health_interval = health_interval
         self.health_timeout = health_timeout
         self.unhealthy_after = unhealthy_after
-        self.hedge_ms = hedge_ms
+        self.hedge_auto = hedge_ms == "auto"
+        if isinstance(hedge_ms, str) and not self.hedge_auto:
+            raise ValueError(
+                f"hedge_ms must be a number, None, or 'auto'; "
+                f"got {hedge_ms!r}")
+        self._hedge_ms: Optional[float] = (
+            None if self.hedge_auto else hedge_ms)
+        self.hedge_quantile = hedge_quantile
+        self.hedge_margin_ms = hedge_margin_ms
+        self.hedge_min_ms = hedge_min_ms
+        self.hedge_max_ms = hedge_max_ms
+        self.hedge_min_samples = hedge_min_samples
+        # the retune window's baseline: client.request_ms series at the
+        # last CONSUMED window (advanced only when enough samples landed)
+        self._hedge_prev: Dict[str, Any] = {}
         # how long a learned non-serving state holds without a pong
         # reconfirming it (see Replica.routable_state)
         self._state_ttl = max(4 * health_interval, 1.0)
@@ -287,28 +316,56 @@ class ReplicaSet:
         self._closed = False
         # replica labels only when there is more than one replica to
         # tell apart — the single-backend case keeps the exact metric
-        # series names the pre-router frontend emitted
-        label = len(backends) > 1
+        # series names the pre-router frontend emitted.  (add_replica
+        # always labels: a growing pool is multi-replica by intent.)
+        self._label = len(backends) > 1
+        self._start_health_opt = start_health
         self._replicas: List[Replica] = []
         for b in backends:
             host, port = _addr(b)
             name = f"{host}:{port}"
-            breaker = CircuitBreaker(
-                threshold=breaker_threshold, reset_s=breaker_reset_s,
-                on_open=self._make_on_open(name))
             self._replicas.append(Replica(
-                host, port, self.retry, self._metrics, breaker,
-                labels={"replica": name} if label else None))
+                host, port, self.retry, self._metrics,
+                self._make_breaker(name, breaker_threshold,
+                                   breaker_reset_s),
+                labels={"replica": name} if self._label else None))
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset_s = breaker_reset_s
         self._m_failovers = self._metrics.counter("router.failovers")
         self._m_hedges = self._metrics.counter("router.hedges")
         self._m_hedge_wins = self._metrics.counter("router.hedge_wins")
         self._m_no_replica = self._metrics.counter("router.no_replica")
         self._m_requests = {r.name: self._metrics.counter(
             "router.requests", replica=r.name) for r in self._replicas}
+        # pool-membership telemetry (ISSUE 12): current size + scale
+        # events by direction — what the autoscale bench and the
+        # controller's post-mortems read
+        self._m_replicas = self._metrics.gauge("router.replicas")
+        self._m_replicas.set(len(self._replicas))
+        self._m_scale = {
+            d: self._metrics.counter("router.scale_events", direction=d)
+            for d in ("up", "down")}
         self._stop_health = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         if start_health and len(self._replicas) > 1:
             self.start_health()
+
+    def _make_breaker(self, name: str, threshold: int,
+                      reset_s: float) -> CircuitBreaker:
+        return CircuitBreaker(threshold=threshold, reset_s=reset_s,
+                              on_open=self._make_on_open(name))
+
+    @property
+    def hedge_ms(self) -> Optional[float]:
+        """The EFFECTIVE hedge threshold (ms): the constructor value
+        for numeric configs, the latest tuned value under
+        ``hedge_ms="auto"`` (None until the first window with enough
+        samples), None when hedging is off."""
+        return self._hedge_ms
+
+    @hedge_ms.setter
+    def hedge_ms(self, value: Optional[float]) -> None:
+        self._hedge_ms = value
 
     def _make_on_open(self, name: str):
         """Breaker-open hook: count the transition AND dump the flight
@@ -336,7 +393,13 @@ class ReplicaSet:
 
     def _health_loop(self) -> None:
         while not self._stop_health.wait(self.health_interval):
-            for r in self._replicas:
+            # snapshot: add_replica/remove_replica mutate the list
+            # concurrently (a probe of a just-retired replica is
+            # harmless — its closed conn fails the ping and it is
+            # already out of rotation)
+            with self._lock:
+                reps = list(self._replicas)
+            for r in reps:
                 if self._closed:
                     return
                 self._probe(r)
@@ -368,6 +431,139 @@ class ReplicaSet:
                 logger.info("replica %s health: healthy, state=%s",
                             r.name, r.state)
 
+    # -- pool membership (ISSUE 12: runtime scale up/down) ---------------------
+
+    def add_replica(self, backend: Backend) -> Replica:
+        """JOIN a new backend to the pool at runtime — the scale-UP
+        actuation.  The replica is routable the moment this returns
+        (atomically: ``_pick`` snapshots the list under the same lock),
+        so callers warm the backend's model BEFORE calling this — the
+        controller's ``ReplicaFactory.create()`` contract — and no
+        client ever eats a cold compile.
+
+        The new replica always carries a ``replica=`` metric label (a
+        growing pool is multi-replica by intent; a pool constructed
+        single-backend keeps its original replica's unlabeled series).
+        Emits ``router.replicas`` and ``router.scale_events``, and
+        starts the health checker once the pool is >1."""
+        host, port = _addr(backend)
+        name = f"{host}:{port}"
+        rep = Replica(host, port, self.retry, self._metrics,
+                      self._make_breaker(name, self._breaker_threshold,
+                                         self._breaker_reset_s),
+                      labels={"replica": name})
+        with self._lock:
+            if self._closed:
+                raise OSError("ReplicaSet is closed")
+            if any(r.name == name for r in self._replicas):
+                raise ValueError(f"replica {name} is already in the pool")
+            self._replicas.append(rep)
+            self._m_requests[name] = self._metrics.counter(
+                "router.requests", replica=name)
+            n = len(self._replicas)
+        self._m_replicas.set(n)
+        self._m_scale["up"].inc()
+        logger.info("replica %s joined the pool (%d replicas)", name, n)
+        if self._start_health_opt and n > 1:
+            self.start_health()
+        return rep
+
+    def remove_replica(self, backend: Union[Backend, Replica],
+                       drain: bool = True,
+                       timeout: float = 30.0) -> bool:
+        """RETIRE a backend from the pool at runtime — the scale-DOWN
+        actuation.  Routing stops immediately (the replica leaves the
+        list under the lock ``_pick`` snapshots); with ``drain`` (the
+        default) the call then waits for the replica's in-flight
+        requests to conclude — predicts hold their own ``Replica``
+        reference, so they finish normally — before closing the
+        connection.  Returns True when the replica drained inside
+        ``timeout`` (False = closed with requests still pending, whose
+        replies the closed conn turns into failovers).
+
+        The caller (the controller) drains and stops the BACKEND
+        process afterwards: stop routing → drain → retire, the PR-5
+        zero-error sequence.  The replica's ``router.requests`` series
+        is retired with it — an autoscaled pool mints monotone
+        addresses, and without retirement every address ever scraped
+        stays in every future scrape."""
+        name = (backend.name if isinstance(backend, Replica)
+                else "%s:%d" % _addr(backend))
+        with self._lock:
+            rep = next((r for r in self._replicas if r.name == name),
+                       None)
+            if rep is None:
+                raise ValueError(f"replica {name} is not in the pool")
+            if len(self._replicas) <= 1:
+                raise ValueError(
+                    "cannot remove the last replica from the pool")
+            self._replicas.remove(rep)
+            self._m_requests.pop(name, None)
+            n = len(self._replicas)
+        self._metrics.remove("router.requests", replica=name)
+        drained = True
+        if drain:
+            deadline = time.monotonic() + timeout
+            while rep.pending > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            drained = rep.pending == 0
+            if not drained:
+                logger.warning("replica %s retired with %d request(s) "
+                               "still pending after %.1fs", name,
+                               rep.pending, timeout)
+        rep.close()
+        self._m_replicas.set(n)
+        self._m_scale["down"].inc()
+        logger.info("replica %s left the pool (%d replicas, drained=%s)",
+                    name, n, drained)
+        return drained
+
+    # -- self-tuning hedging (ISSUE 12: hedge_ms="auto") -----------------------
+
+    def retune_hedge(self) -> Optional[float]:
+        """Re-derive the hedge threshold from the RECENT
+        ``client.request_ms`` distribution — one call per control tick.
+
+        The window is everything observed since the last CONSUMED
+        window (``snapshot_delta`` against a stored baseline, summed
+        across per-replica label series).  Windows with fewer than
+        ``hedge_min_samples`` observations accumulate instead of
+        retuning — the threshold FREEZES at its last value through
+        quiet periods rather than swinging on a handful of samples.
+        The tuned value is ``hedge_quantile`` of the window plus
+        ``hedge_margin_ms``, clamped to [``hedge_min_ms``,
+        ``hedge_max_ms``]; ``router.hedge_ms`` gauges it and
+        ``router.hedge_retunes`` counts the updates.
+
+        No-op (returns the current value) unless the set was built with
+        ``hedge_ms="auto"`` — a numeric config stays byte-identical to
+        the pre-auto router."""
+        if not self.hedge_auto:
+            return self._hedge_ms
+        snap = self._metrics.snapshot()
+        cur = {s: v for s, v in snap.items()
+               if metrics_lib._parse_series(s)[0] == "client.request_ms"}
+        delta = metrics_lib.snapshot_delta(self._hedge_prev, cur)
+        # fold per-replica series into one window distribution
+        window = metrics_lib.MetricsRegistry.merge(
+            [{"client.request_ms": v} for v in delta.values()],
+            drop_labels=("replica",)).get("client.request_ms")
+        count = (window or {}).get("count", 0)
+        if count < self.hedge_min_samples:
+            return self._hedge_ms  # frozen: accumulate, don't consume
+        self._hedge_prev = cur  # consume the window
+        q = metrics_lib.quantile_from_snapshot(window,
+                                               self.hedge_quantile)
+        tuned = min(self.hedge_max_ms,
+                    max(self.hedge_min_ms, q + self.hedge_margin_ms))
+        self._hedge_ms = tuned
+        self._metrics.gauge("router.hedge_ms").set(tuned)
+        self._metrics.counter("router.hedge_retunes").inc()
+        logger.debug("hedge_ms retuned to %.2fms (window p%d=%.2fms, "
+                     "n=%d)", tuned, round(self.hedge_quantile * 100),
+                     q, count)
+        return tuned
+
     # -- routing --------------------------------------------------------------
 
     def _pick(self, exclude: Set[str]) -> Optional[Replica]:
@@ -391,7 +587,8 @@ class ReplicaSet:
                 trace_id: Optional[str] = None,
                 timeout: Optional[float] = None,
                 model: Optional[str] = None,
-                version: Optional[str] = None) -> Optional[np.ndarray]:
+                version: Optional[str] = None,
+                klass: Optional[str] = None) -> Optional[np.ndarray]:
         """One request through the replica set; failover, circuit
         breaking and (optional) hedging happen underneath.
 
@@ -400,7 +597,10 @@ class ReplicaSet:
         ``timeout``: overall client-side wait (default ``query_timeout``,
         bounded near the deadline the way the frontend bounds it).
         ``model``/``version``: multi-model routing, propagated verbatim
-        to every attempt (failover and hedge included)."""
+        to every attempt (failover and hedge included).
+        ``klass``: request class for the server's per-class admission
+        gate (``"interactive"`` | ``"batch"``), likewise propagated to
+        every attempt."""
         if timeout is None:
             timeout = (self.query_timeout if deadline is None
                        else min(self.query_timeout, deadline + 1.0))
@@ -440,7 +640,8 @@ class ReplicaSet:
                         r.pending += 1
                     touched.append(r)
                     r.enqueue(uid, arr, deadline, tid, model=model,
-                              version=version, parent_span=root_sid)
+                              version=version, parent_span=root_sid,
+                              klass=klass)
                 except OSError:
                     r.breaker.record_failure()
                     tried.add(r.name)
@@ -449,7 +650,8 @@ class ReplicaSet:
                                                  deadline, tid, tried,
                                                  touched, model=model,
                                                  version=version,
-                                                 root_span=root_sid)
+                                                 root_span=root_sid,
+                                                 klass=klass)
                 if kind == "ok":
                     out, header = payload
                     rep.breaker.record_success()
@@ -535,7 +737,8 @@ class ReplicaSet:
                deadline: Optional[float], tid: str, tried: Set[str],
                touched: List[Replica], model: Optional[str] = None,
                version: Optional[str] = None,
-               root_span: Optional[str] = None
+               root_span: Optional[str] = None,
+               klass: Optional[str] = None
                ) -> Tuple[str, Any, Optional[Replica]]:
         """Wait for ``uid``'s reply on ``r`` (and on a hedge replica,
         once launched).  Returns ``(kind, payload, replica)`` where kind
@@ -588,7 +791,8 @@ class ReplicaSet:
                     touched.append(h)  # caller cleans up forget/pending
                     try:
                         h.enqueue(uid, arr, deadline, tid, model=model,
-                                  version=version, parent_span=root_span)
+                                  version=version, parent_span=root_span,
+                                  klass=klass)
                         waiting.append(h)
                         self._m_hedges.inc()
                         logger.debug("hedged %s onto %s", uid, h.name)
@@ -665,9 +869,11 @@ class ReplicaSet:
         ``conn.stats``) plus the health/breaker view."""
         out: Dict[str, Any] = {"replicas": {}}
         hz = self.healthz()["replicas"]
-        for r in self._replicas:
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
             st = dict(r._conn.stats) if r._conn is not None else {}
-            st.update(hz[r.name])
+            st.update(hz.get(r.name, {}))
             out["replicas"][r.name] = st
         return out
 
@@ -687,7 +893,9 @@ class ReplicaSet:
         t = self._health_thread
         if t is not None:
             t.join(timeout=2.0)
-        for r in self._replicas:
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
             r.close()
 
     def __enter__(self) -> "ReplicaSet":

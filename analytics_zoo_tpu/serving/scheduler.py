@@ -115,6 +115,14 @@ class Scheduler:
         batch = server._shed_expired([p for p in batch if not p.ping])
         if not batch:
             return
+        # per-class ordering (ISSUE 12): within a round, batch-class
+        # rows stage AFTER interactive/unclassified ones, so when a
+        # round splits across (model, shape) groups the interactive
+        # groups dispatch to a worker first.  The sort is STABLE with a
+        # boolean key: a round with no batch-class rows (all klass=None
+        # pre-klass traffic) keeps its exact arrival order — bisection.
+        if any(p.klass == "batch" for p in batch):
+            batch = sorted(batch, key=lambda p: p.klass == "batch")
         self._m_admitted.observe(len(batch))
         server._assemble_and_dispatch(batch)
 

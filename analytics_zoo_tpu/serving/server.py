@@ -92,7 +92,7 @@ def _config_default(field: str, fallback: Any) -> Any:
 class _Pending:
     __slots__ = ("uuid", "arr", "conn", "lock", "writer", "expires",
                  "trace", "span", "enq_t", "wait_ms", "ping", "model",
-                 "version")
+                 "version", "klass")
 
     def __init__(self, uid: str, arr: Optional[np.ndarray],
                  conn: socket.socket,
@@ -101,7 +101,8 @@ class _Pending:
                  trace: Optional[str] = None, ping: bool = False,
                  model: Optional[str] = None,
                  version: Optional[str] = None,
-                 span: Optional[str] = None):
+                 span: Optional[str] = None,
+                 klass: Optional[str] = None):
         self.uuid = uid
         self.arr = arr
         self.conn = conn
@@ -125,6 +126,9 @@ class _Pending:
         # while the request was queued serves the NEW active version.
         self.model = model
         self.version = version
+        # request class ("interactive" | "batch") for per-class
+        # admission/shedding; None = unclassified (pre-klass behavior)
+        self.klass = klass
 
 
 class _AssembledBatch:
@@ -333,6 +337,18 @@ class ClusterServing:
         # admission gate (a request whose whole budget is below the
         # typical wait would only be shed later — reject it at the door)
         self._wait_ewma = 0.0
+        # per-class admission (ISSUE 12): batch-class traffic sheds
+        # FIRST under pressure — a stricter attainability margin on the
+        # observed wait and an earlier depth cap — so interactive
+        # traffic holds its SLO through a transient.  Unclassified
+        # requests keep the exact pre-klass gate for bisection.
+        self.admission_batch_wait_margin = float(_config_default(
+            "admission_batch_wait_margin", 2.0))
+        self.admission_batch_depth_frac = float(_config_default(
+            "admission_batch_depth_frac", 0.5))
+        # lazily-created per-klass labeled counter handles (bounded:
+        # klass values are validated against protocol.KLASSES at parse)
+        self._m_klass: Dict[Tuple[str, str], metrics_lib.Counter] = {}
         self._faults = faults or get_registry()
         self._queue: "NativeQueue" = NativeQueue(max_items=queue_items)
         # assembled-batch queue: SMALL on purpose — backpressure must
@@ -810,7 +826,15 @@ class ClusterServing:
                             {"uuid": uid, "trace": tid,
                              "metrics": self._metrics.snapshot()}))
                     continue
+                # request class rides the optional-header mechanism:
+                # absent (or unknown) = unclassified, the exact
+                # pre-klass admission path
+                klass = header.get("klass")
+                if klass not in protocol.KLASSES:
+                    klass = None
                 self._count(requests=1)
+                if klass is not None:
+                    self._klass_counter("server.requests", klass).inc()
                 if self._draining.is_set():
                     # retryable by design: the client backs off and its
                     # retry lands on a sibling replica (router) or on
@@ -857,9 +881,12 @@ class ClusterServing:
                 deadline_ms = header.get("deadline_ms")
                 expires = (time.monotonic() + deadline_ms / 1000.0
                            if deadline_ms is not None else None)
-                reason = self._admission_reject(deadline_ms)
+                reason = self._admission_reject(deadline_ms, klass)
                 if reason is not None:
                     self._count(errors=1, admission_rejected=1)
+                    if klass is not None:
+                        self._klass_counter("server.admission_rejected",
+                                            klass).inc()
                     with send_lock:
                         protocol.send_frame(conn, protocol.encode(
                             {"uuid": uid, "trace": tid, "error": reason}))
@@ -871,7 +898,8 @@ class ClusterServing:
                                                   writer, expires,
                                                   trace=tid, model=mname,
                                                   version=mver,
-                                                  span=header.get("span"))
+                                                  span=header.get("span"),
+                                                  klass=klass)
                 # occupancy BEFORE the push: the assembly stage may pop
                 # (and decrement) the instant push returns, and a +1 that
                 # lands after the -1 would miss the high-water mark
@@ -903,7 +931,21 @@ class ClusterServing:
             writer.close()
             conn.close()
 
-    def _admission_reject(self, deadline_ms) -> Optional[str]:
+    def _klass_counter(self, name: str,
+                       klass: str) -> metrics_lib.Counter:
+        """Cached ``<name>{klass=...}`` counter handle — per-request
+        registry name lookups would serialize the conn threads on the
+        registry's global lock.  Bounded: klass is validated against
+        ``protocol.KLASSES`` before this is called."""
+        key = (name, klass)
+        c = self._m_klass.get(key)
+        if c is None:
+            c = self._metrics.counter(name, klass=klass)
+            self._m_klass[key] = c
+        return c
+
+    def _admission_reject(self, deadline_ms,
+                          klass: Optional[str] = None) -> Optional[str]:
         """Admission gate, evaluated at arrival: the rejection reason, or
         None to admit.
 
@@ -916,20 +958,34 @@ class ClusterServing:
           would be shed after waiting anyway; ``deadline unattainable``
           at the door costs the client nothing and the queue no slot.
           Only applies while requests are actually queued (depth >= 1):
-          an idle server's stale EWMA must not reject a fresh burst."""
+          an idle server's stale EWMA must not reject a fresh burst.
+        - **per class** (ISSUE 12): ``klass="batch"`` sheds FIRST — its
+          depth cap is ``admission_queue_limit ×
+          admission_batch_depth_frac`` and its attainability test
+          multiplies the observed wait by
+          ``admission_batch_wait_margin``, so under a transient the
+          batch tier is rejected (retryably) while interactive and
+          unclassified traffic keep the exact pre-klass gate."""
         # rows the continuous scheduler eagerly pulled into its backlog
         # are load the native-queue gauge no longer sees — without them
         # the gate admits into a saturated replica the router should
         # have failed over from (same correction stats() makes)
         depth = self._m_depth.value + self.scheduler.backlog()
-        if (self.admission_queue_limit is not None
-                and depth >= self.admission_queue_limit):
+        limit = self.admission_queue_limit
+        margin = 1.0
+        if klass == "batch":
+            margin = self.admission_batch_wait_margin
+            if limit is not None:
+                limit = max(1, int(limit * self.admission_batch_depth_frac))
+        if limit is not None and depth >= limit:
             return "queue full (admission limit)"
         if (deadline_ms is not None and depth >= 1
                 and 0.0 < self._wait_ewma
-                and deadline_ms < self._wait_ewma):
+                and deadline_ms < self._wait_ewma * margin):
             return (f"deadline unattainable: budget {deadline_ms}ms < "
-                    f"observed queue wait ~{self._wait_ewma:.0f}ms")
+                    f"observed queue wait ~{self._wait_ewma:.0f}ms"
+                    + (f" x {margin:g} (batch margin)"
+                       if margin != 1.0 else ""))
         return None
 
     def _enqueue_ping(self, uid: str, tid: Optional[str],
@@ -1188,6 +1244,9 @@ class ClusterServing:
                         shed_batches=1)
             self._m_shed_per_batch.observe(len(expired))
             for p in expired:
+                if p.klass is not None:
+                    self._klass_counter("server.shed", p.klass).inc()
+            for p in expired:
                 self._send_reply(p, {"uuid": p.uuid, "trace": p.trace,
                                      "error": "deadline exceeded"}, None)
         return live
@@ -1360,6 +1419,27 @@ def main(argv: Optional[List[str]] = None) -> None:
                              "ZooConfig.inference_workers, 2)")
     parser.add_argument("--http-port", type=int, default=None,
                         help="also serve HTTP/JSON on this port")
+    parser.add_argument("--hedge-ms", default=None, metavar="MS|auto",
+                        help="router hedge threshold in ms, or 'auto' to "
+                             "self-tune from the observed latency "
+                             "distribution (requires --http-port)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run a ServingController that scales "
+                             "zoo-serving subprocess replicas to hold "
+                             "the SLO (requires --http-port; see "
+                             "ZooConfig controller_* fields)")
+    parser.add_argument("--slo-p99-ms", type=float, default=None,
+                        help="autoscaler SLO on the windowed client p99 "
+                             "(default: ZooConfig.controller_slo_p99_ms)")
+    parser.add_argument("--min-replicas", type=int, default=None,
+                        help="autoscaler pool floor (default: "
+                             "ZooConfig.controller_min_replicas)")
+    parser.add_argument("--max-replicas", type=int, default=None,
+                        help="autoscaler pool ceiling (default: "
+                             "ZooConfig.controller_max_replicas)")
+    parser.add_argument("--controller-interval", type=float, default=None,
+                        help="seconds between control ticks (default: "
+                             "ZooConfig.controller_interval_s)")
     args = parser.parse_args(argv)
 
     cfg = None
@@ -1388,19 +1468,76 @@ def main(argv: Optional[List[str]] = None) -> None:
                              scheduler=scheduler,
                              models=models or None,
                              ).start()
+    if (args.autoscale or args.hedge_ms is not None) \
+            and args.http_port is None:
+        parser.error("--autoscale/--hedge-ms route through the HTTP "
+                     "frontend's replica set; add --http-port")
     frontend = None
+    controller = None
     if args.http_port is not None:
         from .http_frontend import HTTPFrontend
-        frontend = HTTPFrontend(serving_host=serving.host,
-                                serving_port=serving.port,
-                                host=args.host, port=args.http_port).start()
+        from .router import ReplicaSet
+        hedge = args.hedge_ms
+        if hedge is not None and hedge != "auto":
+            hedge = float(hedge)
+        router = ReplicaSet([(serving.host, serving.port)],
+                            hedge_ms=hedge)
+        frontend = HTTPFrontend(host=args.host, port=args.http_port,
+                                router=router).start()
         logger.info("HTTP frontend on %s:%d", args.host, frontend.port)
+        if args.autoscale:
+            from analytics_zoo_tpu.core.config import ZooConfig
+            from .controller import (HysteresisPolicy, ServingController,
+                                     SubprocessReplicaFactory)
+            base = cfg or ZooConfig()
+            # new replicas are clones of this one: same model/scheduler
+            # flags, their own port (picked by the factory)
+            child: List[str] = []
+            if args.model_dir:
+                child += ["--model-dir", args.model_dir]
+            for spec in args.model or []:
+                child += ["--model", spec]
+            if args.config:
+                child += ["--config", args.config]
+            if args.scheduler:
+                child += ["--scheduler", args.scheduler]
+            child += ["--batch-size", str(args.batch_size)]
+            if args.inference_workers is not None:
+                child += ["--inference-workers",
+                          str(args.inference_workers)]
+            policy = HysteresisPolicy(
+                slo_p99_ms=(args.slo_p99_ms
+                            if args.slo_p99_ms is not None
+                            else base.controller_slo_p99_ms),
+                queue_high=base.controller_queue_high,
+                min_replicas=(args.min_replicas
+                              if args.min_replicas is not None
+                              else base.controller_min_replicas),
+                max_replicas=(args.max_replicas
+                              if args.max_replicas is not None
+                              else base.controller_max_replicas),
+                up_cooldown_s=base.controller_up_cooldown_s,
+                down_cooldown_s=base.controller_down_cooldown_s,
+                down_ticks=base.controller_down_ticks)
+            controller = ServingController(
+                router, SubprocessReplicaFactory(extra_args=child),
+                policy=policy,
+                interval_s=(args.controller_interval
+                            if args.controller_interval is not None
+                            else base.controller_interval_s),
+                scrape_cluster=True,
+                flightrec_dir=base.flightrec_dir).start()
+            logger.info("autoscaler on: slo_p99=%.0fms replicas=[%d,%d]",
+                        policy.slo_p99_ms, policy.min_replicas,
+                        policy.max_replicas)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     try:
         stop.wait()
     finally:
+        if controller is not None:
+            controller.close()  # stop loop, retire subprocess replicas
         if frontend is not None:
             frontend.stop()
         # SIGTERM = rolling-restart contract: drain (retryable

@@ -95,8 +95,8 @@ _PEAK_BF16 = [
 # acceptance-bar evidence must be the final lines (the round-4 artifact
 # lost the opening of its first-printed record to tail truncation).
 CONFIGS = ("lenet", "ncf", "recsys", "autots", "scaling", "serving",
-           "pipeline", "ha", "multimodel", "input_pipeline", "resnet50",
-           "bert")
+           "pipeline", "ha", "multimodel", "autoscale", "input_pipeline",
+           "resnet50", "bert")
 
 
 def peak_flops_per_chip() -> float:
@@ -1514,6 +1514,133 @@ def bench_ha() -> None:
                    "zero-error restart is the portable evidence"})
 
 
+# -- load-adaptive control plane (ISSUE 12) -----------------------------------
+
+def bench_autoscale() -> None:
+    """Control-plane evidence (ISSUE 12 / ROADMAP item 5): a 10x
+    closed-loop QPS step against a ServingController-supervised pool.
+    Recorded: p99 in the FIRST 2s of the burst (pre-scale) vs the LAST
+    2s (post-scale), the scale event timeline relative to the step, and
+    the client-visible error count across the whole run — the
+    acceptance bar is a scale-up during the burst, an error-free drain
+    scale-down after the load drops, and zero client errors end to end.
+    The emitted value is the pre/post-scale burst p99 ratio (>1 = the
+    added replica recovered tail latency); vs_baseline is 1.0 only when
+    the timeline is clean (up while hot, down after calm, 0 errors).
+
+    The model sleeps per batch, so capacity per replica is explicit and
+    the step saturates one replica even on a 1-core host."""
+    import numpy as np
+
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           HysteresisPolicy,
+                                           InProcessReplicaFactory,
+                                           ReplicaSet, ServingController)
+    from analytics_zoo_tpu.serving.client import RetryPolicy
+
+    init_orca_context("local")
+    n_chips, kind, _ = _device_info()
+    one = np.ones((128,), np.float32)
+
+    class SleepyModel:  # 30ms per batch: ~2 concurrent batches/replica
+        def predict(self, x):
+            time.sleep(0.03)
+            return np.asarray(x) * 2.0
+
+    def new_server() -> ClusterServing:
+        # batch 4 @ 30ms x 2 workers ~= 266 rows/s per replica: 32
+        # closed-loop clients pin one replica at ~120ms — a full
+        # histogram bucket over the 100ms SLO — while 2 replicas sit
+        # near ~60ms and the 2-client baseline near ~35ms.  The tick
+        # quantile is bucket-resolved (…, 50, 100, 250 edges), so each
+        # operating point must clear the SLO by a bucket, not a hair.
+        return ClusterServing(SleepyModel(), port=0, batch_size=4,
+                              batch_timeout_ms=2).start()
+
+    seed = new_server()
+    rs = ReplicaSet([(seed.host, seed.port)],
+                    retry=RetryPolicy(max_attempts=6, base_delay=0.02,
+                                      max_delay=0.3, seed=0),
+                    start_health=False)
+    policy = HysteresisPolicy(slo_p99_ms=100.0, min_replicas=1,
+                              max_replicas=3, up_cooldown_s=1.0,
+                              down_cooldown_s=1.0, down_ticks=3)
+    ctl = ServingController(rs, InProcessReplicaFactory(new_server),
+                            policy=policy, interval_s=0.2)
+
+    errors: list = []
+
+    def drive(duration_s: float, clients: int):
+        lat: list = []  # (t_done, seconds)
+        deadline = time.perf_counter() + duration_s
+
+        def client():
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    if rs.predict(one, timeout=30.0) is None:
+                        errors.append("timeout")
+                        continue
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+                    continue
+                lat.append((time.perf_counter(), time.perf_counter() - t0))
+        threads = [threading.Thread(target=client) for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lat
+
+    def p99_ms(window) -> float:
+        if not window:
+            return 0.0
+        ms = np.sort(np.asarray([s for _, s in window])) * 1000
+        return round(float(ms[min(len(ms) - 1, int(len(ms) * 0.99))]), 2)
+
+    try:
+        ctl.start()
+        # baseline: 2 clients hold the windowed p99 under the 50ms
+        # bucket edge — a full bucket below the 100ms SLO
+        calm = drive(2.0, clients=2)
+        t_step = time.time()
+        burst = drive(8.0, clients=32)          # the ~10x step
+        t_burst_end = time.perf_counter()
+        early = [(t, s) for t, s in burst if t < t_burst_end - 6.0]
+        late = [(t, s) for t, s in burst if t >= t_burst_end - 2.0]
+        # load has dropped: wait (bounded) for the drain scale-down
+        deadline = time.monotonic() + 20.0
+        while (not any(e["direction"] == "down" for e in ctl.events)
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+    finally:
+        ctl.close()
+        rs.close()
+        seed.stop()
+
+    ups = [e for e in ctl.events if e["direction"] == "up"]
+    downs = [e for e in ctl.events if e["direction"] == "down"]
+    pre, post = p99_ms(early), p99_ms(late)
+    clean = (len(ups) >= 1 and len(downs) >= 1 and not errors
+             and ups[0]["t"] >= t_step and post > 0 and pre > post)
+    _emit("autoscale_p99_recovery", pre / post if post else 0.0,
+          "x (burst p99, pre-scale-up window vs post)",
+          1.0 if clean else 0.0,
+          {"baseline_p99_ms": p99_ms(calm), "burst_pre_p99_ms": pre,
+           "burst_post_p99_ms": post, "slo_p99_ms": policy.slo_p99_ms,
+           "errors": len(errors),
+           **({"first_error": errors[0]} if errors else {}),
+           "scale_ups": [round(e["t"] - t_step, 2) for e in ups],
+           "scale_downs": [round(e["t"] - t_step, 2) for e in downs],
+           "chips": n_chips, "device_kind": kind,
+           "note": "32 closed-loop clients vs 2 at baseline (~10x step); "
+                   "30ms-per-batch model makes per-replica capacity "
+                   "explicit; scale event times are seconds after the "
+                   "step (acceptance: up during burst, error-free drain "
+                   "down after, 0 client errors)"})
+
+
 # -- pluggable scheduler + model registry (ISSUE 6) ---------------------------
 
 def bench_multimodel() -> None:
@@ -1821,6 +1948,7 @@ _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "scaling": bench_scaling, "serving": bench_serving,
             "pipeline": bench_pipeline, "ha": bench_ha,
             "multimodel": bench_multimodel,
+            "autoscale": bench_autoscale,
             "input_pipeline": bench_input_pipeline}
 
 
@@ -1832,7 +1960,8 @@ _BUDGET = {"bert": (1800, 3), "resnet50": (1800, 3), "lenet": (900, 2),
            "ncf": (900, 2), "recsys": (900, 2), "autots": (1800, 2),
            "scaling": (1800, 2),
            "serving": (1800, 2), "pipeline": (900, 2), "ha": (900, 2),
-           "multimodel": (900, 2), "input_pipeline": (900, 2)}
+           "multimodel": (900, 2), "autoscale": (900, 2),
+           "input_pipeline": (900, 2)}
 
 
 def _device_preflight(max_wait_s: int = 1500,

@@ -64,6 +64,25 @@ def _fault_registry_disarmed():
                     "teardown)")
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_controllers():
+    """Suite hygiene (ISSUE 12): a test that starts a ServingController
+    must stop it (``controller.close()`` / the context manager).  A
+    leaked supervision thread keeps ticking against the shared metrics
+    registry and can scale replicas during LATER tests — fail the test
+    that leaked it, after stopping the thread so the rest of the suite
+    runs clean."""
+    yield
+    from analytics_zoo_tpu.serving import controller as controller_lib
+    leaked = controller_lib.live_controllers()
+    if leaked:
+        for c in leaked:
+            c.stop()
+        pytest.fail("test leaked running ServingController thread(s): "
+                    f"{leaked} (call controller.close() or use it as a "
+                    "context manager)")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_accumulated_state():
     """Full-suite hygiene: 360+ tests in one process accumulate jit
