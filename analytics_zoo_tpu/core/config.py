@@ -210,6 +210,15 @@ class ZooConfig:
     controller_down_cooldown_s: float = 30.0
     controller_down_ticks: int = 3
 
+    # offline batch scoring (serving/batch.py BatchScorer): rows per
+    # journaled shard and the bounded in-flight shard window.  The window
+    # caps how much klass="batch" work can pile onto the replica pool at
+    # once, so interactive traffic keeps its admission headroom; shard
+    # size trades journal granularity (resume wastes at most one shard of
+    # work) against per-shard manifest overhead.
+    batch_shard_size: int = 1024
+    batch_max_inflight: int = 4
+
     # logging / summaries (reference: set_tensorboard, TrainSummary)
     log_dir: str = "/tmp/analytics_zoo_tpu"
     log_level: str = "INFO"

@@ -22,6 +22,7 @@ Registered points (new subsystems add theirs via ``register_point``):
 - ``worker.crash``           training worker dies hard (os._exit) mid-step
 - ``worker.hang``            training worker wedges (long sleep) mid-step
 - ``step.nan``               one train batch is poisoned to non-finite
+- ``batch.shard_fail``       one batch-scoring shard fails before scoring
 
 Usage in a test::
 
@@ -65,6 +66,7 @@ KNOWN_POINTS = {
     "worker.crash",
     "worker.hang",
     "step.nan",
+    "batch.shard_fail",
 }
 
 

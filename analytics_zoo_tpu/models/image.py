@@ -77,6 +77,17 @@ class _SpaceToDepthStem(Module):
 _NF_RELU_GAIN = 1.7139588594436646  # sqrt(2 / (1 - 1/pi)): relu VP gain
 
 
+def _nf_transition(in_channels: int, out_channels: int,
+                   stride: int) -> bool:
+    """Whether an NF block needs a projected (transition) shortcut: the
+    channel count changes or the block strides.  ONE definition, used by
+    both ``_NFResBlock`` (to create the shortcut) and ``ResNet.forward``
+    (to reset the analytic variance tracker) — the two must agree, or
+    the tracked ``beta`` drifts from the variance the shortcuts actually
+    carry."""
+    return in_channels != out_channels or stride != 1
+
+
 class _NFResBlock(Module):
     """Normalizer-free bottleneck block (public technique: Brock et al.
     2021, NF-ResNet): pre-activation ``h = x + alpha * f(relu(x) *
@@ -102,7 +113,7 @@ class _NFResBlock(Module):
         out_f = f * 4 if self.bottleneck else f
         pre = jax.nn.relu(x) * jnp.asarray(
             _NF_RELU_GAIN / self.beta, x.dtype)
-        transition = x.shape[-1] != out_f or self.stride != 1
+        transition = _nf_transition(x.shape[-1], out_f, self.stride)
         # Transition shortcuts branch from the SCALED activation (resets
         # the analytic variance); identity shortcuts keep x itself.
         shortcut = x
@@ -245,7 +256,17 @@ class ResNet(ZooModel):
             for b in range(n_blocks):
                 stride = 2 if (b == 0 and stage > 0) else 1
                 if nf:
-                    transition = b == 0  # channel change or stride 2
+                    # reset iff THIS block takes a projected shortcut —
+                    # the same channel-change-or-stride predicate the
+                    # block itself uses (a projected shortcut branches
+                    # from the scaled activation, restarting the
+                    # analytic variance; an identity shortcut carries
+                    # it).  Notably depth-18/34 stage 0 block 0 is an
+                    # IDENTITY shortcut (stem channels == f, stride 1),
+                    # not a transition.
+                    out_f = f * 4 if bottleneck else f
+                    transition = _nf_transition(h.shape[-1], out_f,
+                                                stride)
                     h = scope.child(
                         _NFResBlock(f, stride, bottleneck,
                                     beta=float(np.sqrt(var)),

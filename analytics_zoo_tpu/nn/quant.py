@@ -36,16 +36,22 @@ class Calibrator:
     mode = "collect"
 
     def observe(self, path: Tuple[str, ...], x: jax.Array) -> None:
-        if isinstance(x, jax.core.Tracer):
+        key = "/".join(path)
+        # concreteness check, not a tracer-type check: tracers subclass
+        # jax.Array and jax.core.Tracer is deprecated as a public name,
+        # so the durable test is whether the value converts to a host
+        # float — a tracer raises a concretization error here on ANY
+        # jax version
+        try:
+            val = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
+        except jax.errors.ConcretizationTypeError:
             raise RuntimeError(
                 "int8 calibration must run UNJITTED: the Calibrator reads "
                 "concrete activation ranges back to the host, which is "
                 "impossible under jit/scan/vmap tracing (layer "
-                f"{'/'.join(path)} saw a tracer). Run the calibration "
+                f"{key} saw a tracer). Run the calibration "
                 "forward outside jax.jit — InferenceModel.load("
-                "calibrate=batch) does this for you.")
-        key = "/".join(path)
-        val = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
+                "calibrate=batch) does this for you.") from None
         self.amax[key] = max(self.amax.get(key, 0.0), val)
 
 

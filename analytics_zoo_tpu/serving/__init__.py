@@ -20,6 +20,8 @@ from .embed_cache import CachedEmbeddingModel, EmbedCache
 from .controller import (HysteresisPolicy, InProcessReplicaFactory,
                          ReplicaFactory, ReplicaHandle, ScalingPolicy,
                          ServingController, SubprocessReplicaFactory)
+from .batch import (BatchJobError, BatchJobReport, BatchScorer,
+                    ShadowDeltas, read_output)
 
 __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
            "InputQueue", "OutputQueue", "RetryPolicy",
@@ -29,4 +31,6 @@ __all__ = ["InferenceModel", "enable_aot_cache", "ClusterServing",
            "EmbedCache", "CachedEmbeddingModel",
            "ServingController", "ScalingPolicy", "HysteresisPolicy",
            "ReplicaFactory", "ReplicaHandle", "InProcessReplicaFactory",
-           "SubprocessReplicaFactory"]
+           "SubprocessReplicaFactory",
+           "BatchScorer", "BatchJobReport", "BatchJobError",
+           "ShadowDeltas", "read_output"]

@@ -423,6 +423,62 @@ class ModelRegistry:
                 self.unload(name, old_ver)
             return version
 
+    def promote(self, name: str, version: str, warm: bool = True,
+                drain: bool = True, drain_timeout: float = 30.0) -> str:
+        """Flip ``name``'s active pointer to an ALREADY-LOADED version —
+        the promotion half of shadow validation (serving/batch.py): a
+        candidate registered with ``make_active=False`` serves pinned
+        canary/shadow traffic until its offline deltas clear the gate,
+        then promotes here without a second load.  Same serialization,
+        warm, counter, observer, and drain semantics as :meth:`swap`;
+        the only difference is that no new version is registered.
+        Promoting the already-active version is a no-op.  Returns
+        ``version``."""
+        version = str(version)
+        with self._swap_lock:
+            with self._lock:
+                e = self._entries.get(name)
+                if e is None:
+                    raise KeyError(f"unknown model {name!r} "
+                                   f"(hosted: {sorted(self._entries)})")
+                model = e.versions.get(version)
+                if model is None:
+                    raise KeyError(
+                        f"unknown version {version!r} of model {name!r} "
+                        f"(loaded: {list(e.versions)})")
+                old_ver = e.active
+                old_model = (e.versions.get(old_ver)
+                             if old_ver is not None else None)
+            if old_ver == version:
+                return version
+            if warm and old_model is not None and hasattr(model,
+                                                          "warm_from"):
+                try:
+                    n = model.warm_from(old_model)
+                    logger.info("model %s: warmed %d executable(s) for "
+                                "promoted version %s", name, n, version)
+                except Exception as err:  # noqa: BLE001 — same contract
+                    # as swap(): warming is an optimization, not a gate
+                    logger.warning("model %s: warming promoted version "
+                                   "%s failed (%s); first post-promotion "
+                                   "batches will compile cold", name,
+                                   version, err)
+            with self._lock:
+                self._entries[name].active = version  # THE atomic flip
+            self._m_swaps.inc()
+            logger.info("model %s: promoted active version %s -> %s",
+                        name, old_ver, version)
+            for fn in list(self._swap_hooks):
+                fn(name, old_ver, version)
+            if drain and old_ver is not None:
+                if not self.drain_version(name, old_ver,
+                                          timeout=drain_timeout):
+                    logger.warning(
+                        "model %s: version %s still has %d in-flight "
+                        "batch(es) after %.1fs", name, old_ver,
+                        self.inflight(name, old_ver), drain_timeout)
+            return version
+
     def drain_version(self, name: str, version: str,
                       timeout: float = 30.0) -> bool:
         """Block until (name, version) has zero in-flight batches or

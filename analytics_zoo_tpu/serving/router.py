@@ -345,6 +345,10 @@ class ReplicaSet:
         self._m_scale = {
             d: self._metrics.counter("router.scale_events", direction=d)
             for d in ("up", "down")}
+        # async predict (submit()): lazy executor, built on first use so
+        # router-only callers never pay a thread pool
+        self._pool = None
+        self.submit_workers = 16
         self._stop_health = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         if start_health and len(self._replicas) > 1:
@@ -725,6 +729,26 @@ class ReplicaSet:
                          "client.won": 0},
                         span_id=info[2], parent=root_sid)
 
+    def submit(self, arr: np.ndarray, **kwargs: Any
+               ) -> "concurrent.futures.Future":
+        """Asynchronous :meth:`predict`: returns a Future resolving to
+        the same result (ndarray, None on timeout, or the raised
+        error).  The executor is lazy and bounded — the batch-scoring
+        engine (serving/batch.py) uses this to keep a WINDOW of shards
+        in flight without one thread per outstanding shard; its own
+        semaphore bounds the window, so the pool here just needs enough
+        threads to cover it (grown on demand up to ``submit_workers``,
+        default 16)."""
+        with self._lock:
+            if self._closed:
+                raise OSError("ReplicaSet is closed")
+            if self._pool is None:
+                import concurrent.futures
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.submit_workers,
+                    thread_name_prefix="rs-submit")
+        return self._pool.submit(self.predict, arr, **kwargs)
+
     def _pick_would_block(self, tried: Set[str]) -> bool:
         with self._lock:
             return not any(
@@ -893,6 +917,11 @@ class ReplicaSet:
         t = self._health_thread
         if t is not None:
             t.join(timeout=2.0)
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            # in-flight submits observe _closed on their next poll slice
+            pool.shutdown(wait=False)
         with self._lock:
             reps = list(self._replicas)
         for r in reps:

@@ -350,6 +350,51 @@ def test_nf_resnet_forward_and_identity_at_init(rng):
     np.testing.assert_allclose(np.asarray(y), np.asarray(h), atol=1e-6)
 
 
+def test_nf_resnet_depth18_stage0_variance_reset_matches_shortcut(rng):
+    """The analytic variance tracker must reset from the SAME
+    channel-change-or-stride predicate the block uses for its projected
+    shortcut (not ``b == 0``).  Depth-18 stage 0 block 0 is the case the
+    two disagreed on: stem channels == f and stride 1, so the block
+    takes an IDENTITY shortcut (no proj conv) — the tracker must see it
+    as a non-transition too."""
+    from analytics_zoo_tpu.models import ResNet
+    from analytics_zoo_tpu.models import image as image_mod
+
+    # 1) block side: depth-18 stage0_block0 has no projection, while
+    # every striding/widening block does
+    x = rng.normal(size=(1, 32, 32, 3)).astype(np.float32)
+    m = ResNet(depth=18, class_num=2, norm="nf", width=8)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    params = variables["params"]
+    assert "proj" not in params["stage0_block0"], \
+        "depth-18 stage 0 block 0 must keep the identity shortcut"
+    assert "proj" in params["stage1_block0"]  # stride-2 transition
+
+    # 2) tracker side: ResNet.forward consults the shared predicate
+    # once per NF block, and the depth-18 stage0 decisions are
+    # (identity, identity) — the old ``b == 0`` reset said transition
+    calls = []
+    real = image_mod._nf_transition
+
+    def spy(in_ch, out_ch, stride):
+        r = real(in_ch, out_ch, stride)
+        calls.append((in_ch, out_ch, stride, r))
+        return r
+
+    image_mod._nf_transition = spy
+    try:
+        m.init(jax.random.PRNGKey(0), x)
+    finally:
+        image_mod._nf_transition = real
+    # depth 18 = [2, 2, 2, 2] basic blocks; forward + block each consult
+    # the predicate, so filter to the tracker's view (stage order holds)
+    assert calls, "variance tracker no longer consults _nf_transition"
+    stage0 = [c for c in calls if c[1] == 8]  # out_channels == width
+    assert all(r is False for (_i, _o, _s, r) in stage0), stage0
+    strided = [c for c in calls if c[2] == 2]
+    assert strided and all(r is True for (*_a, r) in strided)
+
+
 def test_nf_resnet_skip_gain_learns(rng):
     """The folded SkipInit must still receive gradient at init (the
     weight-space adjoint equals the activation-space sum dy*h), and a

@@ -1015,6 +1015,42 @@ def test_save_load_executables_roundtrip(tmp_path):
     assert np.asarray(other.predict(x)).shape == want.shape
 
 
+def test_load_executables_compiles_once_no_per_call_retrace(tmp_path):
+    """A warm-reload artifact must dispatch a cached executable, not
+    re-trace per call: load_executables wraps the deserialized
+    ``exp.call`` in an AOT-compiled ``jax.stages.Compiled`` ONCE at load
+    time, without counting into ``compile_count`` (the hot-swap
+    acceptance treats artifact loads as free)."""
+    import jax
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context("local")
+    model = nn.Sequential([nn.Dense(32, activation="relu"), nn.Dense(4)])
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    src = InferenceModel().load(model, variables)
+    want = np.asarray(src.predict(x))
+    assert src.save_executables(str(tmp_path / "aot")) == 1
+
+    dst = InferenceModel().load(model, variables)
+    assert dst.load_executables(str(tmp_path / "aot")) == 1
+    assert dst.compile_count == 0  # artifact loads are not fresh compiles
+    fns = list(dst._compiled.values())
+    assert len(fns) == 1
+    # the load-time wrap: a Compiled stage, not the raw re-tracing
+    # exp.call bound method
+    assert isinstance(fns[0], jax.stages.Compiled)
+    got = np.asarray(dst.predict(x))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # repeated predicts keep dispatching the SAME cached executable
+    assert dst._compiled[next(iter(dst._compiled))] is fns[0]
+    assert dst.compile_count == 0
+
+
 def test_load_executables_rejects_stale_model_code(tmp_path):
     """A model-code edit that leaves the variable tree identical must
     NOT silently serve the stale artifact: the traced-computation hash
