@@ -23,6 +23,10 @@ Registered points (new subsystems add theirs via ``register_point``):
 - ``worker.hang``            training worker wedges (long sleep) mid-step
 - ``step.nan``               one train batch is poisoned to non-finite
 - ``batch.shard_fail``       one batch-scoring shard fails before scoring
+- ``serving.slow_wire``      per-frame send/recv jitter on the wire protocol
+- ``serving.net_partition``  replica's client conns severed, process lives
+- ``controller.tick_fail``   one autoscaler tick raises mid-observe
+- ``registry.swap_fail``     hot swap raises mid-warm, before the flip
 
 Usage in a test::
 
@@ -48,7 +52,8 @@ import logging
 import random
 import threading
 import time
-from typing import Any, Dict, Iterator, Optional, Type
+import weakref
+from typing import Any, Dict, Iterator, List, Optional, Tuple, Type
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -67,13 +72,29 @@ KNOWN_POINTS = {
     "worker.hang",
     "step.nan",
     "batch.shard_fail",
+    "serving.slow_wire",
+    "serving.net_partition",
+    "controller.tick_fail",
+    "registry.swap_fail",
 }
+
+#: Guards KNOWN_POINTS mutation: the chaos scheduler (core/chaos.py) arms
+#: points from its own thread while subsystems register theirs at import
+#: time and conn threads read the set through ``enable`` — a bare
+#: ``set.add`` racing an ``enable`` membership check is a torn read under
+#: free-threaded builds, and two concurrent registrations must both win.
+_POINTS_LOCK = threading.Lock()
 
 
 def register_point(name: str) -> str:
     """Add a new injection point name (for subsystems grown later).
-    Idempotent; returns the name so it can be used as a module constant."""
-    KNOWN_POINTS.add(name)
+    Thread-safe and idempotent; returns the name so it can be used as a
+    module constant."""
+    if not name or not isinstance(name, str):
+        raise ValueError(f"injection point name must be a non-empty "
+                         f"string, got {name!r}")
+    with _POINTS_LOCK:
+        KNOWN_POINTS.add(name)
     return name
 
 
@@ -106,11 +127,26 @@ class FaultRegistry:
     One process-global instance (``get_registry()``) serves the default
     wiring; components accept an explicit registry for isolation."""
 
+    #: Bound on the ordered fired-event log — a long soak with an
+    #: unlimited-``times`` point must not grow memory without limit.
+    #: Old events are dropped oldest-first past the cap (the sequence
+    #: numbers stay monotonic so consumers can detect the truncation).
+    MAX_FIRED_EVENTS = 65536
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._specs: Dict[str, _Spec] = {}
         self._hits: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}
+        # ordered (seq, point) log of every firing — the reproducibility
+        # evidence a seeded chaos storm (core/chaos.py) is asserted on:
+        # two runs with the same seed must produce the identical sequence
+        self._events: List[Tuple[int, str]] = []
+        self._event_seq = 0
+        # chaos schedules currently attached to this registry (weak:
+        # an abandoned schedule object must not be kept alive by the
+        # leak-check bookkeeping itself)
+        self._schedules: "weakref.WeakSet" = weakref.WeakSet()
 
     # -- arming ---------------------------------------------------------------
 
@@ -124,7 +160,9 @@ class FaultRegistry:
         ``exc`` is set, raises ``exc(message)``.  ``after`` lets the first
         ``after`` hits pass through untouched — "crash on step K" is
         ``enable("worker.crash", times=1, after=K-1)``."""
-        if name not in KNOWN_POINTS:
+        with _POINTS_LOCK:  # consistent read against register_point
+            known = name in KNOWN_POINTS
+        if not known:
             raise ValueError(
                 f"unknown injection point {name!r}; known points: "
                 f"{sorted(KNOWN_POINTS)} (add new ones via register_point)")
@@ -141,11 +179,13 @@ class FaultRegistry:
             self._specs.pop(name, None)
 
     def reset(self) -> None:
-        """Disarm every point and zero the counters."""
+        """Disarm every point and zero the counters + fired-event log."""
         with self._lock:
             self._specs.clear()
             self._hits.clear()
             self._fired.clear()
+            self._events.clear()
+            self._event_seq = 0
 
     @contextlib.contextmanager
     def armed(self, name: str, **kwargs: Any) -> Iterator["FaultRegistry"]:
@@ -193,6 +233,7 @@ class FaultRegistry:
                 fired = True
                 delay = spec.delay
                 self._fired[name] = self._fired.get(name, 0) + 1
+                self._log_event(name)
                 if spec.times is not None:
                     spec.times -= 1
                     if spec.times <= 0:
@@ -241,6 +282,12 @@ class FaultRegistry:
                 self._hits[name] = self._hits.get(name, 0) + hits
             if fired > 0:
                 self._fired[name] = self._fired.get(name, 0) + fired
+                # the child's intra-process firing order is lost by the
+                # counter mirror; the events land at absorb time, in
+                # absorb order — ordering across forked workers is a
+                # per-process property, not a cross-process one
+                for _ in range(fired):
+                    self._log_event(name)
                 spec = self._specs.get(name)
                 if spec is not None and spec.times is not None:
                     spec.times -= fired
@@ -250,6 +297,35 @@ class FaultRegistry:
             from . import metrics as metrics_lib
             metrics_lib.get_registry().inc("faults.fired", fired,
                                            point=name)
+
+    def _log_event(self, name: str) -> None:
+        """Append one firing to the ordered event log (lock held)."""
+        self._event_seq += 1
+        self._events.append((self._event_seq, name))
+        if len(self._events) > self.MAX_FIRED_EVENTS:
+            del self._events[:len(self._events) - self.MAX_FIRED_EVENTS]
+
+    # -- chaos-schedule bookkeeping -------------------------------------------
+
+    def attach_schedule(self, schedule: Any) -> None:
+        """Record a chaos schedule (core/chaos.py) driving this registry,
+        weakly, so leak checks can see schedules still running after a
+        test body finished.  Idempotent."""
+        with self._lock:
+            self._schedules.add(schedule)
+
+    def running_schedules(self) -> List[Any]:
+        """Every attached schedule object whose ``running`` is truthy —
+        the conftest leak guard stops (and fails on) these."""
+        with self._lock:
+            scheds = list(self._schedules)
+        return [s for s in scheds if getattr(s, "running", False)]
+
+    def schedule_state(self) -> List[str]:
+        """Sorted human-readable descriptions of the RUNNING attached
+        schedules (empty = nothing running; the leak-clean state)."""
+        return sorted(str(getattr(s, "name", None) or repr(s))
+                      for s in self.running_schedules())
 
     # -- observability --------------------------------------------------------
 
@@ -262,6 +338,20 @@ class FaultRegistry:
         """How many times the point actually fired."""
         with self._lock:
             return self._fired.get(name, 0)
+
+    def fired_events(self, points: Optional[Any] = None) -> List[str]:
+        """Point names in the ORDER they fired (the seeded-storm
+        reproducibility evidence: same seed + same traffic shape ⇒ the
+        identical sequence).  ``points`` (an iterable of names) filters
+        to just those points — the usual call passes a storm's point
+        list so unrelated background firings don't pollute the
+        comparison.  Bounded by :data:`MAX_FIRED_EVENTS` oldest-first."""
+        with self._lock:
+            events = list(self._events)
+        if points is not None:
+            keep = set(points)
+            return [name for _, name in events if name in keep]
+        return [name for _, name in events]
 
     def is_armed(self, name: str) -> bool:
         with self._lock:

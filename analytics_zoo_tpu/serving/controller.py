@@ -46,6 +46,7 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core import faults as faults_lib
 from ..core import flightrec
 from ..core import metrics as metrics_lib
 from .router import ReplicaSet
@@ -262,12 +263,30 @@ class ServingController:
     replicas are other processes with their own registries).
 
     Metrics: ``controller.ticks``, ``controller.scale_ups``,
-    ``controller.scale_downs``, ``controller.errors`` counters and
-    ``controller.p99_ms`` / ``controller.queue_depth`` gauges (the
-    signals as the policy saw them).  Every scale-down decision dumps a
-    flight record (reason ``scale_down``) naming the retired replica and
-    the triggering metrics.
+    ``controller.scale_downs``, ``controller.errors``,
+    ``controller.degraded`` counters and ``controller.p99_ms`` /
+    ``controller.queue_depth`` gauges (the signals as the policy saw
+    them).  Every scale-down decision dumps a flight record (reason
+    ``scale_down``) naming the retired replica and the triggering
+    metrics.
+
+    Degraded mode: ``DEGRADED_AFTER`` (3) CONSECUTIVE tick failures put
+    the loop in bounded exponential backoff (doubling per further
+    failure, capped at ``MAX_BACKOFF_S``) and dump ONE flight record
+    (reason ``controller_degraded``) naming the failing tick stage
+    (``observe`` | ``decide`` | ``actuate``) — a persistently broken
+    signal source must not burn a tight error loop against the router,
+    and the dump, not a silently growing ``controller.errors`` counter,
+    is the on-call evidence.  One successful tick restores the normal
+    interval.  The ``controller.tick_fail`` injection point
+    (core/faults.py) fires at the top of every tick so chaos storms can
+    exercise exactly this path.
     """
+
+    #: consecutive tick failures before degraded mode (backoff + dump)
+    DEGRADED_AFTER = 3
+    #: ceiling on the degraded-mode tick interval, seconds
+    MAX_BACKOFF_S = 30.0
 
     def __init__(self, router: ReplicaSet, factory: ReplicaFactory,
                  policy: Optional[ScalingPolicy] = None,
@@ -294,8 +313,16 @@ class ServingController:
         self._m_ups = self._metrics.counter("controller.scale_ups")
         self._m_downs = self._metrics.counter("controller.scale_downs")
         self._m_errors = self._metrics.counter("controller.errors")
+        self._m_degraded = self._metrics.counter("controller.degraded")
         self._m_p99 = self._metrics.gauge("controller.p99_ms")
         self._m_depth = self._metrics.gauge("controller.queue_depth")
+        self._faults = faults_lib.get_registry()
+        #: which tick stage ran last (``observe``/``decide``/``actuate``/
+        #: ``idle``) — named by the ``controller_degraded`` flight record
+        self._last_stage = "idle"
+        #: consecutive failed ticks (0 = healthy); read by tests and the
+        #: degraded-mode backoff
+        self.consecutive_failures = 0
         _LIVE.add(self)
 
     # -- lifecycle ------------------------------------------------------------
@@ -362,12 +389,49 @@ class ServingController:
         self._managed[handle.name] = handle
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        delay = self.interval_s
+        while not self._stop.wait(delay):
             try:
                 self.tick()
             except Exception:
                 self._m_errors.inc()
-                logger.exception("controller tick failed")
+                self.consecutive_failures += 1
+                logger.exception("controller tick failed (stage=%s, "
+                                 "%d consecutive)", self._last_stage,
+                                 self.consecutive_failures)
+                if self.consecutive_failures >= self.DEGRADED_AFTER:
+                    # bounded exponential backoff: a persistently failing
+                    # signal source (scrape wedge, dead router) must not
+                    # burn a tight error loop; double per further failure
+                    delay = min(
+                        self.interval_s
+                        * 2 ** (self.consecutive_failures
+                                - self.DEGRADED_AFTER + 1),
+                        self.MAX_BACKOFF_S)
+                    if self.consecutive_failures == self.DEGRADED_AFTER:
+                        # ONE dump per degradation episode, at entry —
+                        # the on-call evidence, not a dump per failure
+                        self._m_degraded.inc()
+                        flightrec.dump(
+                            "controller_degraded",
+                            dump_dir=self._flightrec_dir,
+                            extra={"stage": self._last_stage,
+                                   "consecutive_failures":
+                                       self.consecutive_failures,
+                                   "backoff_s": delay,
+                                   "replicas":
+                                       len(self._router.replicas)})
+                        logger.warning(
+                            "controller degraded: %d consecutive tick "
+                            "failures (stage=%s); backing off to %.2fs",
+                            self.consecutive_failures, self._last_stage,
+                            delay)
+                continue
+            if self.consecutive_failures:
+                logger.info("controller recovered after %d failed "
+                            "tick(s)", self.consecutive_failures)
+            self.consecutive_failures = 0
+            delay = self.interval_s
 
     # -- observe --------------------------------------------------------------
 
@@ -407,18 +471,26 @@ class ServingController:
         decision (-1, 0, +1) — tests call this directly for
         deterministic control flow."""
         with self._tick_lock:
+            self._last_stage = "observe"
+            # ``controller.tick_fail`` (core/faults.py): an armed fault
+            # fails the whole tick — the seam chaos storms use to prove
+            # the degraded-mode backoff above survives a broken tick
+            self._faults.raise_if("controller.tick_fail")
             sig = self.signals()
             self._m_p99.set(sig["p99_ms"] if sig["p99_ms"] is not None
                             else 0.0)
             self._m_depth.set(sig["queue_depth"])
             if self._router.hedge_auto:
                 self._router.retune_hedge()
+            self._last_stage = "decide"
             decision = self.policy.decide(sig)
+            self._last_stage = "actuate"
             if decision > 0:
                 self._scale_up(sig)
             elif decision < 0:
                 self._scale_down(sig)
             self._m_ticks.inc()
+            self._last_stage = "idle"
             return decision
 
     def _event(self, direction: str, replica: str,

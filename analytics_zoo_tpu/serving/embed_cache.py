@@ -12,7 +12,13 @@ Correctness across hot swaps: entries are keyed by
 ``ModelRegistry.on_swap`` / ``on_unload`` — the outgoing version's rows
 are dropped at the flip, and the version in the key makes a stale hit
 structurally impossible even before the invalidation runs (the new
-adapter reads under the new version key).
+adapter reads under the new version key).  The flip also FENCES the
+outgoing version: the registry drains in-flight old-version batches
+AFTER the swap hooks fire, so a batch completing mid-drain would
+otherwise re-insert the rows the invalidation just dropped — fenced
+inserts are refused instead (the batch's own reply is unaffected; only
+the cache write is), and a version is unfenced if a later swap or
+promotion makes it active again (rollback).
 
 ``CachedEmbeddingModel`` is the serving-model adapter tying it
 together: one request row = ``[user_id | k candidate item ids]``; the
@@ -59,6 +65,8 @@ class EmbedCache:
         self._m_misses = reg.counter("embed.cache_misses")
         self._m_evict = reg.counter("embed.cache_evictions")
         self._m_size = reg.gauge("embed.cache_size")
+        self._m_fenced = reg.counter("embed.cache_fenced_inserts")
+        self._fenced: set = set()  # {(model, version)} retired by swap
         self._registries: List[Any] = []
 
     def __len__(self) -> int:
@@ -88,9 +96,15 @@ class EmbedCache:
     def insert(self, model: str, version: str, table: str,
                ids: Sequence[int], rows: np.ndarray) -> None:
         """Cache freshly-gathered ``rows`` (``[len(ids), dim]``),
-        evicting least-recently-used entries beyond ``capacity``."""
+        evicting least-recently-used entries beyond ``capacity``.
+        Inserts for a fenced (swapped-out) version are refused — an
+        in-flight batch finishing during the post-flip drain must not
+        resurrect rows the swap invalidation already dropped."""
         evicted = 0
         with self._lock:
+            if (model, str(version)) in self._fenced:
+                self._m_fenced.inc(len(ids))
+                return
             for i, row in zip(ids, np.asarray(rows)):
                 self._rows[(model, version, table, int(i))] = row
                 self._rows.move_to_end((model, version, table, int(i)))
@@ -143,10 +157,17 @@ class EmbedCache:
 
     def _on_swap(self, name: str, old_version: Optional[str],
                  new_version: str) -> None:
+        with self._lock:
+            # a rollback re-activating a fenced version reopens it
+            self._fenced.discard((name, str(new_version)))
+            if old_version is not None and old_version != new_version:
+                self._fenced.add((name, str(old_version)))
         if old_version is not None and old_version != new_version:
             self.invalidate(name, old_version)
 
     def _on_unload(self, name: str, version: str) -> None:
+        with self._lock:
+            self._fenced.add((name, str(version)))
         self.invalidate(name, version)
 
 

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from analytics_zoo_tpu.core import faults as faults_lib
 from analytics_zoo_tpu.core import metrics as metrics_lib
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -384,6 +385,17 @@ class ModelRegistry:
                 old_ver = e.active
                 old_model = (e.versions.get(old_ver)
                              if old_ver is not None else None)
+            # ``registry.swap_fail`` (core/faults.py): an injected
+            # mid-warm failure — the incoming model blows up BEFORE the
+            # new version is registered or the active pointer moves, so
+            # the raise propagates to the upgrader while the old version
+            # stays active, routable, and untouched (no ``registry.swaps``
+            # increment, in-flight batches on the old version complete).
+            # Deliberately OUTSIDE the warn-only except below: a real
+            # ``warm_from`` hiccup degrades to cold compiles, but this
+            # point simulates a broken candidate that must abort the
+            # upgrade atomically.
+            faults_lib.get_registry().raise_if("registry.swap_fail")
             if warm and old_model is not None and hasattr(model,
                                                           "warm_from"):
                 try:

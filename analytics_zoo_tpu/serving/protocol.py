@@ -33,6 +33,15 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from analytics_zoo_tpu.core import faults as faults_lib
+
+#: ``serving.slow_wire`` (core/faults.py): seeded per-frame send/recv
+#: jitter.  Armed with a ``delay``, every firing hit sleeps inside the
+#: fault registry BEFORE the syscall — a degraded-network storm
+#: (core/chaos.py) slows both directions of every connection without
+#: touching sockets.  Disarmed (always, in production) a hit costs one
+#: lock + two dict ops, the same budget as the other per-request seams.
+
 #: Upper bound on a single frame's payload (default 256 MiB).  A length
 #: prefix above this is treated as protocol corruption, not a request.
 #: Module-level so deployments (and tests) can raise/lower it.
@@ -144,6 +153,7 @@ def encode_parts(header: Dict[str, Any],
 
 
 def send_frame(sock: socket.socket, data: Frame) -> None:
+    faults_lib.get_registry().fire("serving.slow_wire")
     sock.sendall(data)
 
 
@@ -151,6 +161,7 @@ def send_frame_parts(sock: socket.socket, parts: List[memoryview]) -> None:
     """Scatter-gather send via ``sendmsg`` (one syscall, no join copy),
     handling partial sends; falls back to ``sendall`` of the joined
     frame where ``sendmsg`` is unavailable."""
+    faults_lib.get_registry().fire("serving.slow_wire")
     if not hasattr(sock, "sendmsg"):  # pragma: no cover - exotic platform
         sock.sendall(b"".join(parts))
         return
@@ -188,6 +199,11 @@ def recv_frame(sock: socket.socket) -> Optional[bytearray]:
     hdr = bytearray(4)
     if not _recv_into_exact(sock, memoryview(hdr)):
         return None
+    # jitter lands between the length prefix and the payload read: the
+    # frame is committed on the wire, so an armed delay stretches the
+    # receiver's assembly (the slow-consumer half of a degraded network)
+    # without ever tearing a frame
+    faults_lib.get_registry().fire("serving.slow_wire")
     (length,) = struct.unpack(">I", hdr)
     if length > MAX_FRAME_BYTES:
         raise ValueError(

@@ -161,6 +161,11 @@ class Replica:
         self._conn: Optional[_Conn] = None
         self._conn_lock = threading.Lock()
         self._closed = False
+        # held (non-blocking) by a cluster_metrics scrape of this
+        # replica: a scrape thread wedged on a partitioned backend must
+        # make LATER scrapes skip the replica, not stack a new blocked
+        # thread per tick
+        self._scrape_busy = threading.Lock()
 
     @property
     def conn(self) -> _Conn:
@@ -863,16 +868,24 @@ class ReplicaSet:
         cluster series (counters sum, gauge high-water marks
         max-merge, histogram buckets add).  Unreachable replicas are
         skipped — a scrape must never block on a dead backend longer
-        than ``timeout``."""
+        than ``timeout``: the scrape threads are joined against one
+        shared deadline, and a replica whose PREVIOUS scrape is still
+        wedged (partitioned backend: the send blocks, the reply never
+        comes) is skipped outright instead of stacking another blocked
+        thread per controller tick."""
         with self._lock:
             reps = [r for r in self._replicas if r.healthy]
         results: List[Optional[Dict[str, Any]]] = [None] * len(reps)
 
         def scrape(i: int, r: Replica) -> None:
+            if not r._scrape_busy.acquire(blocking=False):
+                return  # previous scrape still wedged on this backend
             try:
                 results[i] = r.conn.metrics_snapshot(timeout)
             except OSError:
                 pass
+            finally:
+                r._scrape_busy.release()
 
         # concurrent scrape: N wedged-but-connected replicas must cost
         # ~one timeout total, not timeout × N (a Prometheus scrape job

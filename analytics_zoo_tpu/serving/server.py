@@ -665,6 +665,33 @@ class ClusterServing:
         logger.info("ClusterServing %s:%d hard-killed", self.host,
                     self.port)
 
+    def partition(self) -> None:
+        """Sever every open client connection WITHOUT killing the
+        process — the ``serving.net_partition`` failure mode: from the
+        clients' side the replica went dark mid-conversation, but the
+        pipeline, the native queue, the pending table and the listening
+        socket are all still alive, so the partition "heals" as soon as
+        a client reconnects.  Requests whose conn died before their
+        reply was written get their reply dropped on the floor by the
+        writer (exactly like a real partition); clients recover via
+        reconnect + idempotent same-uuid re-enqueue, and the router's
+        breaker/health machinery decides whether to route around the
+        replica in the meantime."""
+        with self._threads_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        logger.info("ClusterServing %s:%d partitioned: %d client "
+                    "conn(s) severed (process and listener stay up)",
+                    self.host, self.port, len(conns))
+
     def stop(self, drain_timeout: float = 5.0) -> None:
         """Graceful drain: stop intake, let in-flight pipeline stages
         finish (assembly → workers → reply writers, in dependency
@@ -810,6 +837,14 @@ class ClusterServing:
                     # the router recover via reconnect/failover.
                     logger.debug("fault: replica down")
                     self.kill()
+                    return
+                if self._faults.fire("serving.net_partition"):
+                    # injected network partition: every client conn is
+                    # severed but the PROCESS lives — pipeline, queue,
+                    # pending state and the listener all survive, so the
+                    # replica "heals" the moment clients reconnect.
+                    logger.debug("fault: net partition")
+                    self.partition()
                     return
                 header, arr = protocol.decode(frame)
                 uid = header.get("uuid") or str(uuid_mod.uuid4())

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""CI guard: the fault-injection point table in docs/robustness.md
+matches the code (sibling of check_metric_docs.py).
+
+ISSUE 14 found the table had ALREADY drifted — ``batch.shard_fail``
+shipped in the batch-scoring PR without a row — and the chaos harness
+multiplies the cost of drift: a storm author picks points from the
+documented table, and an undocumented point is a storm nobody writes.
+This closes the loop the same way the metric/span catalogs are closed,
+without importing (or running) anything:
+
+- **code side**: the ``KNOWN_POINTS = {...}`` set literal in
+  ``core/faults.py``, PLUS every ``register_point("...")`` call site
+  across ``analytics_zoo_tpu/`` (subsystems grown later register their
+  points at import time; both spellings are first-class).  Points
+  registered dynamically from a variable are invisible to this guard —
+  the point vocabulary is closed by design, so don't.
+- **docs side**: the first column of the "## Injection points" table in
+  docs/robustness.md (rows starting with ``| `` + a backtick).
+
+Exit 1 with a readable diff when they disagree in either direction.
+Wired into the test suite
+(``tests/test_chaos.py::test_fault_point_docs_match_code``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "analytics_zoo_tpu"
+DOC = REPO / "docs" / "robustness.md"
+
+#: the KNOWN_POINTS set literal (module level, core/faults.py)
+_KNOWN_BLOCK = re.compile(r"^KNOWN_POINTS = \{([^}]*)\}", re.M | re.S)
+#: register_point("name") call sites — not the def itself
+_REGISTER = re.compile(r'register_point\(\s*"([a-z0-9_.]+)"')
+_NAME = re.compile(r'"([a-z0-9_.]+)"')
+
+#: table rows: | `point` | seam ... |
+_DOC_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|", re.M)
+
+
+def code_points() -> set:
+    text = (PKG / "core" / "faults.py").read_text()
+    m = _KNOWN_BLOCK.search(text)
+    if m is None:
+        print("check_fault_docs: KNOWN_POINTS literal not found in "
+              "core/faults.py — update _KNOWN_BLOCK", file=sys.stderr)
+        sys.exit(2)
+    points = set(_NAME.findall(m.group(1)))
+    for py in sorted(PKG.rglob("*.py")):
+        points.update(_REGISTER.findall(py.read_text()))
+    return points
+
+
+def documented() -> set:
+    text = DOC.read_text()
+    m = re.search(r"\n## Injection points\n", text)
+    if m is None:
+        print("check_fault_docs: docs/robustness.md has no "
+              "'## Injection points' section", file=sys.stderr)
+        sys.exit(2)
+    body = text[m.end():]
+    nxt = re.search(r"\n## ", body)
+    if nxt is not None:
+        body = body[:nxt.start()]
+    return set(_DOC_ROW.findall(body))
+
+
+def main() -> int:
+    code = code_points()
+    docs = documented()
+    undocumented = sorted(code - docs)
+    stale = sorted(docs - code)
+    if undocumented:
+        print("fault points in code but MISSING from the "
+              "docs/robustness.md injection-point table:")
+        for n in undocumented:
+            print(f"  - {n}")
+    if stale:
+        print("fault points documented in docs/robustness.md but not "
+              "in KNOWN_POINTS or any register_point() call:")
+        for n in stale:
+            print(f"  - {n}")
+    if undocumented or stale:
+        return 1
+    print(f"fault-point table in sync: {len(code)} points")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

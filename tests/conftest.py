@@ -56,6 +56,21 @@ def _fault_registry_disarmed():
     yield
     from analytics_zoo_tpu.core import faults
     reg = faults.get_registry()
+    storms = reg.running_schedules()
+    if storms:
+        # ISSUE 14: a leaked chaos storm keeps ARMING points from its
+        # background thread, so stop the storms before the armed-point
+        # sweep below (each stop() disarms its own points).
+        names = reg.schedule_state()
+        for storm in storms:
+            try:
+                storm.stop()
+            except Exception:  # noqa: BLE001 — hygiene must not mask
+                pass
+        reg.reset()
+        pytest.fail(f"test leaked running chaos schedule(s): {names} "
+                    "(use the ChaosSchedule context manager or call "
+                    "stop() in teardown)")
     leaked = reg.armed_points()
     if leaked:
         reg.reset()  # disarm so subsequent tests run clean

@@ -114,6 +114,37 @@ def test_embed_cache_attach_swap_and_unload_invalidation():
     assert c.invalidate("m", "v3") == 1    # detached: nothing auto-dropped
 
 
+def test_embed_cache_fences_swapped_out_version():
+    """The registry drains old-version batches AFTER the swap hooks run,
+    so a batch finishing mid-drain races the invalidation: its cache
+    insert must be refused, not land as a resurrected stale row (the
+    chaos sweeps shook this out as a lost hot-swap invalidation)."""
+    class _Stub:
+        def predict(self, x):
+            return np.asarray(x)
+
+    reg = metrics.get_registry()
+    base = reg.snapshot().get("embed.cache_fenced_inserts", 0)
+    c = EmbedCache(capacity=100)
+    mreg = ModelRegistry()
+    c.attach(mreg)
+    mreg.register("m", _Stub(), version="v1")
+    c.insert("m", "v1", "t", [0, 1], np.zeros((2, 2), np.float32))
+    mreg.swap("m", _Stub(), version="v2", warm=False)
+    # the straggler: an in-flight v1 batch completes after the flip
+    c.insert("m", "v1", "t", [0, 1], np.zeros((2, 2), np.float32))
+    assert c.invalidate("m", "v1") == 0
+    assert reg.snapshot()["embed.cache_fenced_inserts"] == base + 2
+    # the new version caches normally
+    c.insert("m", "v2", "t", [0], np.zeros((1, 2), np.float32))
+    assert len(c) == 1
+    # rollback: re-promoting v1 unfences it
+    mreg.promote("m", "v1", warm=False)
+    c.insert("m", "v1", "t", [3], np.zeros((1, 2), np.float32))
+    hits, _ = c.lookup("m", "v1", "t", [3])
+    assert list(hits) == [3]
+
+
 # -- CachedEmbeddingModel -----------------------------------------------------
 
 def test_cached_adapter_ranks_like_full_model(recsys_parts):
