@@ -162,6 +162,19 @@ class ChaosSchedule:
             return {"times": rng.randint(1, 3),
                     "delay": round(rng.uniform(0.01, 0.05), 4),
                     "seed": spec_seed}
+        if point == "checkpoint.slow_write":
+            # wedge the background checkpoint writer, never the step
+            # loop: long enough to overlap the next trigger (so the
+            # in-flight policy is exercised), short enough to drain
+            # inside the window
+            return {"times": rng.randint(1, 3),
+                    "delay": round(rng.uniform(0.02, 0.1), 4),
+                    "seed": spec_seed}
+        if point == "checkpoint.write_fail":
+            # enough consecutive failures to exhaust the save's retry
+            # budget at least once, so the writer's error path (forced
+            # full, tip rewind) runs — not just a retried blip
+            return {"times": rng.randint(2, 4), "seed": spec_seed}
         if point == "controller.tick_fail":
             # >= DEGRADED_AFTER consecutive failures so storms exercise
             # the degraded-mode backoff, bounded so the loop recovers
@@ -361,6 +374,11 @@ class InvariantChecker:
     6. **No leaked threads / fds / shm** at teardown:
        :meth:`baseline` before the topology comes up,
        :meth:`assert_teardown` after it is torn down.
+    7. **Manifest consistency** (ISSUE 15): every generation visible in
+       a checkpoint manager's ``MANIFEST.jsonl`` is complete and
+       crc-clean, and no base+delta restore chain was broken by GC —
+       asserted after kill/write-fail storms against the async writer
+       (:meth:`check_manifest`).
     """
 
     def __init__(self, servers: Sequence[Any] = (),
@@ -605,6 +623,20 @@ class InvariantChecker:
                 "batch_row_exactness",
                 f"{out_dir}: journal covers [0, {cursor}) but the job "
                 f"had {n_rows} rows")
+        with self._lock:
+            return list(self.violations)
+
+    def check_manifest(self, ckpt_dir: str) -> List[str]:
+        """Invariant 7: every visible generation in the checkpoint
+        manager's manifest at ``ckpt_dir`` is complete and crc-clean,
+        and GC never broke a live base+delta chain.  Chain gaps caused
+        by failed (never-landed) writes are NOT violations — restore
+        falls back across them by design; ``verify_path`` reports those
+        as warnings only."""
+        from . import ckpt_manager as ckpt_mgr_lib
+        errors, _warns = ckpt_mgr_lib.verify_path(ckpt_dir)
+        for err in errors:
+            self._violate("manifest_consistency", f"{ckpt_dir}: {err}")
         with self._lock:
             return list(self.violations)
 

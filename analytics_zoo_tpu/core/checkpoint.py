@@ -70,6 +70,28 @@ class CheckpointCorruptError(RuntimeError):
     save time (or the file vanished).  The message names the file."""
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory so the rename that just landed in it is
+    durable.  ``os.replace`` makes a file swap atomic against crashes,
+    but on ext4-ordered (and most journaled) mounts the *directory
+    entry* itself is only durable after the parent directory is
+    fsync'd — a power cut right after the rename can otherwise roll the
+    directory back and lose the entire generation.  Best-effort: some
+    filesystems (and Windows) refuse O_RDONLY dir fds; a checkpoint on
+    such a mount keeps the pre-fix semantics rather than failing the
+    save."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _crc32_file(path: str) -> int:
     crc = 0
     with open(path, "rb") as f:
@@ -263,6 +285,7 @@ def save(path: str, tree: Any, step: Optional[int] = None,
                 np.savez(f, **my_shards)
             my_crc = _crc32_file(tmp_sh)  # crc what was actually written
             os.replace(tmp_sh, os.path.join(path, _shards_name(gen, pidx)))
+            fsync_dir(path)  # make the rename itself durable (ISSUE 15)
 
         _write_with_retry(_write_shards, f"shards p{pidx}", retries,
                           retry_delay)
@@ -313,6 +336,9 @@ def save(path: str, tree: Any, step: Optional[int] = None,
                     os.replace(tmp_prev, os.path.join(path, _PREV_META))
             os.replace(tmp, os.path.join(path, _data_name(gen)))
             os.replace(tmp_meta, os.path.join(path, _META))  # commit point
+            # a crash after the renames but before the directory entry
+            # is journaled would lose the whole generation (ISSUE 15)
+            fsync_dir(path)
 
         # a failed attempt leaves only fresh-generation temp/data files —
         # the previous checkpoint's files and meta are untouched, so

@@ -134,3 +134,33 @@ class PreemptionGuard:
         flags = multihost_utils.process_allgather(
             np.asarray([self.flagged], np.int32))
         return bool(np.any(flags))
+
+
+def checkpoint_for_exit(manager, tree, step: int, extra=None,
+                        touched=None, grace_s: float = 30.0
+                        ) -> Optional[int]:
+    """The SIGTERM save, via an async :class:`CheckpointManager`
+    (core/ckpt_manager.py): bounded time-to-exit inside the platform's
+    grace window.
+
+    When a snapshot is already in flight its host copy exists — the
+    expensive device sync already happened BEFORE the signal — so the
+    fastest consistent exit is to drain the writer and report that
+    snapshot's step, accepting a slightly older recovery point.  Only
+    when nothing is in flight does this take a fresh (blocking) save.
+    Returns the step made durable, or None when nothing landed inside
+    ``grace_s`` (the caller exits anyway; resume falls back to the
+    previous visible generation — crash consistency does not depend on
+    this save landing).
+    """
+    saved = manager.save_for_exit(tree, step, extra=extra,
+                                  touched=touched, timeout=grace_s)
+    if saved is None:
+        logger.warning(
+            "preemption save did not land within the %.1fs grace "
+            "window; resume will use the previous generation", grace_s)
+    elif saved != step:
+        logger.info(
+            "preemption exit reused the in-flight snapshot of step %d "
+            "(current step %d)", saved, step)
+    return saved

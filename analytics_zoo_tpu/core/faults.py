@@ -17,6 +17,7 @@ Registered points (new subsystems add theirs via ``register_point``):
 - ``serving.health_fail``    server swallows a health ping (no pong)
 - ``serving.replica_down``   serving replica dies hard (SIGKILL-equivalent)
 - ``checkpoint.write_fail``  transient checkpoint write failure (OSError)
+- ``checkpoint.slow_write``  async checkpoint writer stalls before writing
 - ``feed.stall``             data feed stalls before yielding a batch
 - ``feed.read_fail``         one sample-loader read fails (streaming feed)
 - ``worker.crash``           training worker dies hard (os._exit) mid-step
@@ -66,6 +67,7 @@ KNOWN_POINTS = {
     "serving.health_fail",
     "serving.replica_down",
     "checkpoint.write_fail",
+    "checkpoint.slow_write",
     "feed.stall",
     "feed.read_fail",
     "worker.crash",

@@ -184,7 +184,13 @@ class ZooEstimator:
                  augment: Any = None,
                  grad_compression: Optional[str] = None,
                  embedding_lr: Optional[float] = None,
-                 profile: Any = None):
+                 profile: Any = None,
+                 checkpoint_async: bool = False,
+                 checkpoint_inflight: str = "latest-wins",
+                 checkpoint_keep_last: int = 3,
+                 checkpoint_anchor_every: int = 0,
+                 checkpoint_delta: bool = True,
+                 checkpoint_compact_every: int = 8):
         """``sharding``: parameter-sharding strategy over the mesh —
         "dp" (replicate params; batch sharding only, the reference's only
         mode), "tp" (Megatron tensor-parallel rules over the ``model`` axis),
@@ -382,6 +388,37 @@ class ZooEstimator:
                     "preemption_checkpoint=True needs model_dir")
             from analytics_zoo_tpu.core.failover import PreemptionGuard
             self._preempt = PreemptionGuard(preemption_sync_every).install()
+        # async checkpointing (ISSUE 15, core/ckpt_manager.py): trigger
+        # saves, preemption saves, rollback and auto_resume all route
+        # through one CheckpointManager on model_dir.  Default OFF — the
+        # sync ckpt_io path below is byte-for-byte the pre-15 behavior.
+        self._ckpt_mgr = None
+        self._track_touched = False
+        if checkpoint_async:
+            if model_dir is None:
+                raise ValueError("checkpoint_async=True needs model_dir")
+            if jax.process_count() > 1:
+                # multihost saves are collective (every process writes
+                # its own shards); a background thread on process 0
+                # cannot run that protocol alone — fall back to the
+                # inline collective save rather than deadlock
+                logger.warning(
+                    "checkpoint_async=True is single-host only; "
+                    "multihost run falls back to synchronous saves")
+            else:
+                from analytics_zoo_tpu.core.ckpt_manager import (
+                    CheckpointManager)
+                self._ckpt_mgr = CheckpointManager(
+                    model_dir, keep_last=checkpoint_keep_last,
+                    anchor_every=checkpoint_anchor_every,
+                    inflight=checkpoint_inflight,
+                    compact_every=checkpoint_compact_every,
+                    retries=self.checkpoint_retries,
+                    delta=checkpoint_delta)
+                # journal (table, ids, rows) deltas between full saves:
+                # needs the in-jit touched-row bitmask (cleared in
+                # _ensure_initialized when the model has no tables)
+                self._track_touched = bool(checkpoint_delta)
 
     # -- state ----------------------------------------------------------------
 
@@ -510,8 +547,39 @@ class ZooEstimator:
             # keeps ITS OWN quantization error — in ts so it checkpoints
             # (and donates) with the rest of the train state
             ts["ef"] = self._init_error_feedback(params, mesh)
+        # delta checkpoints (ISSUE 15): one bool bitmask per sparse table
+        # marking rows touched since the last accepted save.  Lives in ts
+        # so the jit step updates it in place (donated with the rest) —
+        # the sparse path already dedups touched ids, so marking them is
+        # one scatter per table.  NEVER checkpointed (stripped in save).
+        self._track_touched = bool(self._track_touched
+                                   and self._sparse_paths)
+        if self._track_touched:
+            ts["touched"] = self._init_touched(ts["params"])
         self._ts = ts
         self._build_steps(mesh)
+
+    def _init_touched(self, params: Any) -> Dict[str, Any]:
+        from analytics_zoo_tpu.parallel import embedding as emb_lib
+        _dense, tables = emb_lib.split_sparse(params)
+        return {tp: jnp.zeros((t.shape[0],), dtype=bool)
+                for tp, t in tables.items()}
+
+    def _collect_touched(self) -> Optional[Dict[str, np.ndarray]]:
+        """Touched-row ids per table since the last accepted save, keyed
+        by FULL-TREE path (the manager splits the whole train state, so
+        table paths carry the ``params/`` prefix)."""
+        masks = (self._ts or {}).get("touched")
+        if not masks:
+            return None
+        return {"params/" + tp: np.nonzero(np.asarray(mask))[0]
+                for tp, mask in masks.items()}
+
+    def _reset_touched(self) -> None:
+        masks = (self._ts or {}).get("touched")
+        if masks:
+            self._ts["touched"] = {tp: jnp.zeros_like(m)
+                                   for tp, m in masks.items()}
 
     def _init_error_feedback(self, params: Any, mesh) -> Any:
         from analytics_zoo_tpu.parallel.util import (batch_shard_count,
@@ -701,6 +769,7 @@ class ZooEstimator:
                 (loss_val, new_state), grads = jax.value_and_grad(
                     lossf, has_aux=True)(ts["params"], batch["x"],
                                          batch["y"], ts["state"], step_rng)
+            new_touched = None
             if sparse_paths:
                 # dense optimizer over dense params; sparse tables update
                 # below by scatter-add on the unique rows only
@@ -708,10 +777,21 @@ class ZooEstimator:
                                                dense_p)
                 dense_new = optax.apply_updates(dense_p, updates)
                 new_tables = dict(tables)
+                if "touched" in ts:
+                    new_touched = dict(ts["touched"])
                 for key, g in tap_grads.items():
                     tp = emb_lib.table_path_of(key)
                     new_tables[tp] = new_tables[tp].at[uniqs[key]].add(
                         (-embed_lr * g).astype(new_tables[tp].dtype))
+                    if new_touched is not None:
+                        # delta checkpoints (ISSUE 15): mark the batch's
+                        # unique rows dirty.  The dedup buffer pads with
+                        # id 0, and a skip_step guard leaves rows
+                        # unmodified — both make the mask a SUPERSET of
+                        # truly-changed rows, which only costs journal
+                        # bytes, never correctness.
+                        new_touched[tp] = new_touched[tp].at[
+                            uniqs[key]].set(True)
                 params = emb_lib.merge_sparse(dense_new, new_tables)
                 grads_for_norm = (grads, tap_grads)
             else:
@@ -756,6 +836,9 @@ class ZooEstimator:
                       "rng": ts["rng"], "bad_steps": bad_steps}
             if "ef" in ts:
                 new_ts["ef"] = new_ef if new_ef is not None else ts["ef"]
+            if "touched" in ts:
+                new_ts["touched"] = (new_touched if new_touched is not None
+                                     else ts["touched"])
             return new_ts, loss_val
 
         def eval_step(ts, batch):
@@ -886,7 +969,7 @@ class ZooEstimator:
             prefetch = config_default("prefetch",
                                       ZooConfig.prefetch)
         if (auto_resume and self._ts is None and self.model_dir
-                and ckpt_io.exists(self.model_dir)):
+                and self._ckpt_exists(self.model_dir)):
             self.load(self.model_dir)
             logger.info("auto-resumed from %s at step %d (epoch %d)",
                         self.model_dir, self._py_step, self._epoch)
@@ -1089,13 +1172,25 @@ class ZooEstimator:
                                 and self._preempt.should_checkpoint(
                                     self._py_step)):
                             self._stop_profile()
-                            path = self.save(self.model_dir)
                             from analytics_zoo_tpu.core.failover import \
                                 Preempted
+                            if self._ckpt_mgr is not None:
+                                # bounded time-to-exit: reuse an
+                                # in-flight snapshot when one exists
+                                from analytics_zoo_tpu.core.failover \
+                                    import checkpoint_for_exit
+                                saved = checkpoint_for_exit(
+                                    self._ckpt_mgr, self._save_tree(),
+                                    self._py_step,
+                                    extra={"epoch": int(self._epoch)},
+                                    touched=self._collect_touched())
+                                raise Preempted(saved or self._py_step,
+                                                self.model_dir)
+                            path = self.save(self.model_dir)
                             raise Preempted(self._py_step, path)
                         if trigger and self.model_dir and trigger.fires(
                                 step=self._py_step, epoch_end=False):
-                            self.save(self.model_dir)
+                            self._trigger_save()
                 finally:
                     # mid-epoch exits (rollback, preemption, raise) must
                     # not leak the prefetch producer thread
@@ -1199,7 +1294,7 @@ class ZooEstimator:
                                                     self._epoch)
                 if trigger and self.model_dir and trigger.fires(
                         step=self._py_step, epoch_end=True):
-                    self.save(self.model_dir)
+                    self._trigger_save()
             self._stop_profile()  # short runs: close the trace at fit end
         except Exception as e:
             # flight recorder: an unhandled step exception (including a
@@ -1218,6 +1313,11 @@ class ZooEstimator:
             ZooEstimator._device_lock.release()
             if self._preempt is not None:
                 self._preempt.active = False
+            if self._ckpt_mgr is not None:
+                # drain the background writer so fit() returning means
+                # every accepted generation is durable; a writer error
+                # was already logged (and forced the next save full)
+                self._ckpt_mgr.flush(raise_error=False)
             if record_spans:
                 trace_lib.record(
                     fit_tid, "train.fit",
@@ -1264,7 +1364,11 @@ class ZooEstimator:
                 f"non-finite loss at step {self._py_step}: rollback budget "
                 f"({self.nan_max_rollbacks}) exhausted — the fault is "
                 f"deterministic, not transient")
-        if not (self.model_dir and ckpt_io.exists(self.model_dir)):
+        if self._ckpt_mgr is not None:
+            # an accepted-but-unwritten snapshot is a valid rollback
+            # target once it lands; drain the writer before probing
+            self._ckpt_mgr.flush(raise_error=False)
+        if not (self.model_dir and self._ckpt_exists(self.model_dir)):
             self._stop_profile()
             raise NonFiniteLossError(
                 self._py_step,
@@ -1387,6 +1491,35 @@ class ZooEstimator:
 
     # -- persistence ----------------------------------------------------------
 
+    def _save_tree(self) -> Dict[str, Any]:
+        """The checkpointable train state: everything but the touched
+        bitmasks (delta bookkeeping, rebuilt fresh on load)."""
+        return {k: v for k, v in self._ts.items() if k != "touched"}
+
+    def _ckpt_exists(self, path: str) -> bool:
+        """A resumable checkpoint at ``path``: sync ckpt_io layout OR an
+        async manager manifest with a visible generation."""
+        if ckpt_io.exists(path):
+            return True
+        from analytics_zoo_tpu.core import ckpt_manager as ckpt_mgr_lib
+        return ckpt_mgr_lib.has_manifest(path)
+
+    def _trigger_save(self) -> None:
+        """One checkpoint-trigger firing: async through the manager
+        (touched rows reset only when the snapshot was ACCEPTED — a
+        skip-policy drop keeps them marked for the next save), else the
+        inline sync save."""
+        if self._ckpt_mgr is None:
+            self.save(self.model_dir)
+            return
+        with ZooEstimator._device_lock:
+            accepted = self._ckpt_mgr.save_async(
+                self._save_tree(), step=self._py_step,
+                extra={"epoch": int(self._epoch)},
+                touched=self._collect_touched())
+            if accepted and self._track_touched:
+                self._reset_touched()
+
     def save(self, path: Optional[str] = None) -> str:
         path = path or self.model_dir
         if path is None:
@@ -1394,7 +1527,18 @@ class ZooEstimator:
         if self._ts is None:
             raise ValueError("nothing to save: model not initialized yet")
         with ZooEstimator._device_lock:  # device_get sweeps device state
-            tree = jax.tree_util.tree_map(lambda x: x, self._ts)
+            if self._ckpt_mgr is not None and path == self.model_dir:
+                # the manager owns model_dir: a blocking full save keeps
+                # MANIFEST.jsonl the single source of truth (mixing raw
+                # ckpt_io saves into the same directory would fork it)
+                self._ckpt_mgr.save(self._save_tree(),
+                                    step=self._py_step,
+                                    extra={"epoch": int(self._epoch)},
+                                    touched=self._collect_touched())
+                if self._track_touched:
+                    self._reset_touched()
+                return path
+            tree = jax.tree_util.tree_map(lambda x: x, self._save_tree())
             return ckpt_io.save(path, tree, step=int(self._ts["step"]),
                                 extra={"epoch": int(self._epoch)},
                                 retries=self.checkpoint_retries)
@@ -1413,7 +1557,15 @@ class ZooEstimator:
         # mesh-aware restore: leaves that were sharded at save time come
         # back already placed under their recorded PartitionSpec — a
         # cross-host (ZeRO-3) checkpoint is never densely assembled
-        tree = ckpt_io.restore(path, mesh=mesh)
+        if self._ckpt_mgr is not None and path == self.model_dir:
+            # manifest-driven restore: newest VISIBLE generation, with
+            # delta replay onto its base full (core/ckpt_manager.py)
+            tree = self._ckpt_mgr.restore(mesh=mesh)
+            rec = self._ckpt_mgr.last_restored or {}
+            extra = rec.get("extra") or {}
+        else:
+            tree = ckpt_io.restore(path, mesh=mesh)
+            extra = ckpt_io.load_extra(path)
         self._py_step = int(np.asarray(tree["step"]))
         if self.nan_policy == "skip_step":
             # sync the host mirror with the restored on-device counter so
@@ -1422,8 +1574,7 @@ class ZooEstimator:
             # own mirror (ts never carries their count) — left untouched
             # so a mid-fit rollback load doesn't erase the triggering step.
             self.bad_steps = int(np.asarray(tree.get("bad_steps", 0)))
-        self._epoch = int(ckpt_io.load_extra(path).get("epoch",
-                                                       self._epoch))
+        self._epoch = int(extra.get("epoch", self._epoch))
         rules = _resolve_sharding_rules(self.sharding)
         replicated = NamedSharding(mesh, P())
 
@@ -1475,6 +1626,14 @@ class ZooEstimator:
         if self.grad_compression == "int8":
             self._ts["ef"] = self._restore_error_feedback(
                 tree.get("ef"), params, mesh)
+        # delta bookkeeping is NOT checkpointed: fresh zero masks are
+        # exactly right after a restore — rows diverge from the restored
+        # generation (the manager's new chain tip) only once training
+        # touches them again
+        self._track_touched = bool(self._track_touched
+                                   and self._sparse_paths)
+        if self._track_touched:
+            self._ts["touched"] = self._init_touched(params)
         if self._train_step is None:
             self._build_steps(mesh)
 

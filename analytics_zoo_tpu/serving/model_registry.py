@@ -435,6 +435,32 @@ class ModelRegistry:
                 self.unload(name, old_ver)
             return version
 
+    def swap_from_checkpoint(self, name: str, loader: Any, ckpt_dir: str,
+                             version: Optional[str] = None,
+                             **swap_kwargs: Any) -> str:
+        """Hot-swap ``name`` from the newest VISIBLE generation of an
+        async-checkpoint directory (core/ckpt_manager.py): the serving
+        half of train-to-serve refresh.  The manifest decides what is
+        loadable — an in-flight or torn write is never served, because
+        its generation has no committed manifest line yet.
+
+        ``loader`` is called as ``loader(tree, record)`` with the
+        restored train-state tree and its manifest record, and must
+        return the servable model (wrap params into an InferenceModel,
+        etc.).  ``version`` defaults to ``ckpt-<generation>``, so
+        repeated refreshes against an unchanged checkpoint collide
+        loudly instead of silently re-serving identical weights.  All
+        other keywords forward to :meth:`swap`."""
+        from analytics_zoo_tpu.core import ckpt_manager as ckpt_mgr_lib
+        tree, rec = ckpt_mgr_lib.restore_path(ckpt_dir)
+        model = loader(tree, rec)
+        if version is None:
+            version = f"ckpt-{rec['gen']}"
+        logger.info("model %s: swapping in checkpoint generation %s "
+                    "(step %s) from %s", name, rec.get("gen"),
+                    rec.get("step"), ckpt_dir)
+        return self.swap(name, model, version=version, **swap_kwargs)
+
     def promote(self, name: str, version: str, warm: bool = True,
                 drain: bool = True, drain_timeout: float = 30.0) -> str:
         """Flip ``name``'s active pointer to an ALREADY-LOADED version —

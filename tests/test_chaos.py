@@ -346,6 +346,139 @@ def test_bench_has_chaos_config():
     assert "chaos" in bench._BUDGET
 
 
+# -- async checkpoint crash storms (ISSUE 15) ---------------------------------
+
+CKPT_WORKER = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "ckpt_chaos_worker.py")
+
+
+def _spawn_ckpt_worker(model_dir, mirror_dir):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, CKPT_WORKER, str(model_dir), str(mirror_dir)],
+        env=env, cwd=repo, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def test_sigkill_mid_async_save_restores_consistent_generation(tmp_path):
+    """THE crash-consistency acceptance (ISSUE 15): SIGKILL a trainer
+    that is streaming async full+delta generations, at seeded offsets —
+    the survivor must always restore a COMPLETE crc-clean generation
+    whose every leaf (embedding rows included) is bit-identical to the
+    synchronous mirror the worker wrote for that step."""
+    import random
+
+    import jax
+
+    from analytics_zoo_tpu.core import checkpoint as ckpt_io
+    from analytics_zoo_tpu.core import ckpt_manager as ckpt_mgr_lib
+
+    rng = random.Random(20150815)
+    for rep in range(2):
+        model_dir = tmp_path / f"m{rep}"
+        mirror_dir = tmp_path / f"mirror{rep}"
+        proc = _spawn_ckpt_worker(model_dir, mirror_dir)
+        try:
+            # let >=2 trigger firings land: under the block in-flight
+            # policy the 2nd TRIGGERED line implies the 1st generation's
+            # manifest line is already durable — the kill can tear the
+            # tail but never leave the directory unrestorable
+            want = 2 + rng.randrange(0, 3)
+            seen = 0
+            deadline = time.time() + 240
+            while seen < want:
+                assert time.time() < deadline, "worker never triggered"
+                line = proc.stdout.readline()
+                assert line, "worker exited early"
+                if "TRIGGERED" in line:
+                    seen += 1
+            time.sleep(rng.uniform(0.0, 0.05))  # land mid-write
+            proc.kill()  # SIGKILL: no handlers, no flush, no goodbye
+            proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=60)
+
+        errors, _warns = ckpt_mgr_lib.verify_path(str(model_dir))
+        assert errors == [], errors
+        assert InvariantChecker().check_manifest(str(model_dir)) == []
+        tree, rec = ckpt_mgr_lib.restore_path(str(model_dir))
+        mirror = str(mirror_dir / f"step_{rec['step']}")
+        assert ckpt_io.exists(mirror), \
+            f"restored step {rec['step']} has no mirror"
+        want_tree = ckpt_io.restore(mirror)
+        got = jax.tree_util.tree_leaves(
+            {k: tree[k] for k in ("params", "state", "opt_state")})
+        want = jax.tree_util.tree_leaves(
+            {k: want_tree[k] for k in ("params", "state", "opt_state")})
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        assert int(np.asarray(tree["step"])) == int(rec["step"])
+
+
+def test_async_ckpt_survives_write_fail_and_slow_write_storm(tmp_path):
+    """``checkpoint.write_fail`` exhausting the writer's retry budget
+    plus ``checkpoint.slow_write`` stalls, mid-async-fit: the failed
+    generation must not poison the manifest (law 7), the next save is
+    forced full, and a post-storm restore is bit-identical to the live
+    train state."""
+    import jax as jax_lib
+
+    from analytics_zoo_tpu.core import init_orca_context
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.orca.learn import Estimator
+    from analytics_zoo_tpu.orca.learn.trigger import SeveralIteration
+
+    init_orca_context("local")
+
+    def ncf():
+        return NeuralCF(user_count=64, item_count=40, class_num=2,
+                        user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                        mf_embed=8, sharded_embeddings=True)
+
+    d = str(tmp_path / "m")
+    rng = np.random.default_rng(3)
+    x = np.stack([rng.integers(0, 64, 256),
+                  rng.integers(0, 40, 256)], 1).astype(np.int32)
+    y = (rng.random(256) < 0.5).astype(np.int32)
+    kw = dict(loss="sparse_categorical_crossentropy", optimizer="adam",
+              learning_rate=1e-2, seed=7)
+    est = Estimator.from_keras(ncf(), model_dir=d, checkpoint_async=True,
+                               checkpoint_inflight="block", **kw)
+    # 4 injected write errors: 3 exhaust one save's retry budget (the
+    # save FAILS), the 4th is absorbed by the next save's retries
+    faults_lib.get_registry().enable("checkpoint.write_fail", times=4)
+    faults_lib.get_registry().enable("checkpoint.slow_write", times=2,
+                                     delay=0.02)
+    est.fit((x, y), epochs=2, batch_size=64,
+            checkpoint_trigger=SeveralIteration(2), verbose=False)
+    est._ckpt_mgr.flush(raise_error=False)
+    snap = metrics_lib.get_registry().snapshot()
+    assert snap.get("ckpt.write_errors", 0) >= 1, snap
+    assert est._ckpt_mgr.verify() == []
+    assert InvariantChecker().check_manifest(d) == []
+    # post-storm blocking save must land cleanly and restore exactly
+    est.save()
+    est2 = Estimator.from_keras(ncf(), model_dir=d,
+                                checkpoint_async=True, **kw)
+    est2.load(d)
+    got = jax_lib.tree_util.tree_leaves(jax_lib.device_get(
+        {k: est2._ts[k] for k in ("params", "state", "opt_state")}))
+    want = jax_lib.tree_util.tree_leaves(jax_lib.device_get(
+        {k: est._ts[k] for k in ("params", "state", "opt_state")}))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert int(np.asarray(est2._ts["step"])) == int(
+        np.asarray(est._ts["step"]))
+
+
 # -- THE acceptance storm -----------------------------------------------------
 
 STORM_POINTS = ("serving.slow_wire", "serving.replica_down",
