@@ -334,10 +334,15 @@ def _apply_delta_rows(tables: Dict[str, Any], rec: dict,
         raise ckpt_io.CheckpointCorruptError(
             f"delta rows file {rows_path} is corrupt: crc32 "
             f"{got:#010x} != recorded {int(want):#010x}")
+    raw_names = rec.get("rows_dtype") or {}
     with np.load(rows_path, allow_pickle=False) as data:
         for i, tp in enumerate(rec.get("tables") or []):
             ids = data[f"ids_{i}"]
-            rows = data[f"rows_{i}"]
+            # ml_dtypes rows were stored as uint bit patterns
+            # (ckpt_io._npz_safe); view them back to the real dtype —
+            # a value cast here would turn bits into garbage numerics
+            rows = ckpt_io._from_npz(data[f"rows_{i}"],
+                                     raw_names.get(tp))
             if not ids.size:
                 continue
             tbl = tables.get(tp)
@@ -771,13 +776,16 @@ class CheckpointManager:
             if snap.kind == "full":
                 rec["ordinal"] = snap.ordinal
             else:
-                order, crc = self._write_rows(gen_dir, snap.tables)
+                order, crc, dtypes = self._write_rows(gen_dir,
+                                                      snap.tables)
                 rec["base"] = snap.base
                 rec["prev"] = snap.prev
                 rec["tables"] = order
                 rec["rows"] = {tp: int(snap.tables[tp][0].size)
                                for tp in order}
                 rec["rows_crc32"] = crc
+                if dtypes:
+                    rec["rows_dtype"] = dtypes
             nbytes = _dir_bytes(gen_dir)
             rec["bytes"] = nbytes
             self._append_manifest(rec)
@@ -798,13 +806,19 @@ class CheckpointManager:
     def _write_rows(self, gen_dir: str,
                     tables: Optional[Dict[str, Tuple[np.ndarray,
                                                      np.ndarray]]]
-                    ) -> Tuple[List[str], int]:
+                    ) -> Tuple[List[str], int, Dict[str, str]]:
         order = sorted(tables or {})
         payload: Dict[str, np.ndarray] = {}
+        dtypes: Dict[str, str] = {}
         for i, tp in enumerate(order):
             ids, rows = tables[tp]
             payload[f"ids_{i}"] = ids
-            payload[f"rows_{i}"], _raw = ckpt_io._npz_safe(rows)
+            # ml_dtypes rows (bfloat16/float8) land in the npz as uint
+            # bit-pattern views; the real dtype name must ride the
+            # manifest so restore can reinterpret bits, not value-cast
+            payload[f"rows_{i}"], raw = ckpt_io._npz_safe(rows)
+            if raw is not None:
+                dtypes[tp] = raw
         final = os.path.join(gen_dir, _ROWS)
         tmp = os.path.join(gen_dir,
                            f".rows.{secrets.token_hex(4)}.tmp")
@@ -825,7 +839,7 @@ class CheckpointManager:
             except OSError:
                 pass
         ckpt_io.fsync_dir(gen_dir)
-        return order, ckpt_io._crc32_file(final)
+        return order, ckpt_io._crc32_file(final), dtypes
 
     def _append_manifest(self, rec: dict) -> None:
         """Durable manifest append: O_APPEND write + fsync of the file

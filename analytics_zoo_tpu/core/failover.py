@@ -41,12 +41,21 @@ logger = logging.getLogger("analytics_zoo_tpu")
 class Preempted(BaseException):
     """Raised (after the checkpoint is safely written) when training was
     interrupted by SIGTERM/SIGINT.  BaseException so generic ``except
-    Exception`` retry loops don't swallow a shutdown request."""
+    Exception`` retry loops don't swallow a shutdown request.
 
-    def __init__(self, step: int, path: Optional[str]):
-        super().__init__(f"preempted at step {step}; checkpoint: {path}")
+    ``step`` is the recovery point: the step made durable by the exit
+    save when one landed (``durable=True``), else the step training
+    stopped at.  ``durable=False`` means the grace-window save did NOT
+    land — resume falls back to an older generation, so callers must
+    not assume ``step`` is on disk."""
+
+    def __init__(self, step: int, path: Optional[str],
+                 durable: bool = True):
+        state = "checkpoint" if durable else "checkpoint NOT durable; dir"
+        super().__init__(f"preempted at step {step}; {state}: {path}")
         self.step = step
         self.path = path
+        self.durable = durable
 
 
 class PreemptionGuard:

@@ -1184,8 +1184,15 @@ class ZooEstimator:
                                     self._py_step,
                                     extra={"epoch": int(self._epoch)},
                                     touched=self._collect_touched())
-                                raise Preempted(saved or self._py_step,
-                                                self.model_dir)
+                                # saved=0 is a real durable step;
+                                # saved=None means nothing landed in
+                                # the grace window — report the current
+                                # step but flag it as not durable
+                                raise Preempted(
+                                    saved if saved is not None
+                                    else self._py_step,
+                                    self.model_dir,
+                                    durable=saved is not None)
                             path = self.save(self.model_dir)
                             raise Preempted(self._py_step, path)
                         if trigger and self.model_dir and trigger.fires(
@@ -1558,11 +1565,24 @@ class ZooEstimator:
         # back already placed under their recorded PartitionSpec — a
         # cross-host (ZeRO-3) checkpoint is never densely assembled
         if self._ckpt_mgr is not None and path == self.model_dir:
-            # manifest-driven restore: newest VISIBLE generation, with
-            # delta replay onto its base full (core/ckpt_manager.py)
-            tree = self._ckpt_mgr.restore(mesh=mesh)
-            rec = self._ckpt_mgr.last_restored or {}
-            extra = rec.get("extra") or {}
+            from analytics_zoo_tpu.core import ckpt_manager as \
+                ckpt_mgr_lib
+            if (not ckpt_mgr_lib.has_manifest(path)
+                    and ckpt_io.exists(path)):
+                # legacy sync checkpoint predates checkpoint_async
+                # being turned on for this model_dir: resume from it
+                # directly; the next trigger save writes the first
+                # manifest generation (a full — the manager's chain
+                # tip is unset)
+                tree = ckpt_io.restore(path, mesh=mesh)
+                extra = ckpt_io.load_extra(path)
+            else:
+                # manifest-driven restore: newest VISIBLE generation,
+                # with delta replay onto its base full
+                # (core/ckpt_manager.py)
+                tree = self._ckpt_mgr.restore(mesh=mesh)
+                rec = self._ckpt_mgr.last_restored or {}
+                extra = rec.get("extra") or {}
         else:
             tree = ckpt_io.restore(path, mesh=mesh)
             extra = ckpt_io.load_extra(path)

@@ -78,6 +78,34 @@ def test_delta_restore_equals_full_restore_exactly(tmp_path):
     _assert_trees_equal(got, want)
 
 
+def test_delta_rows_preserve_ml_dtypes_bit_exact(tmp_path):
+    """bfloat16 tables: npz stores journaled rows as uint16 bit-pattern
+    views (ckpt_io._npz_safe), so restore must reinterpret bits via the
+    manifest's ``rows_dtype`` — a value cast would turn every journaled
+    row into garbage numerics while the file still crc-verifies."""
+    d = str(tmp_path / "c")
+    t = {"params": {"emb": {"sharded_embeddings":
+                            jnp.zeros((8, 4), jnp.bfloat16)}},
+         "step": jnp.asarray(0)}
+    with cm.CheckpointManager(d) as m:
+        m.save(t, step=1)
+        tbl = t["params"]["emb"]["sharded_embeddings"]
+        t["params"]["emb"]["sharded_embeddings"] = \
+            tbl.at[jnp.asarray([1, 3])].set(
+                jnp.asarray([[0.1] * 4, [-2.5] * 4], jnp.bfloat16))
+        m.save(t, step=2, touched={TP: np.array([1, 3])})
+        rec = m.generations()[-1]
+        assert rec["kind"] == "delta"
+        assert rec["rows_dtype"] == {TP: "bfloat16"}
+        assert m.verify() == []
+        got = m.restore()
+    got_tbl = np.asarray(got["params"]["emb"]["sharded_embeddings"])
+    want_tbl = np.asarray(t["params"]["emb"]["sharded_embeddings"])
+    assert got_tbl.dtype == want_tbl.dtype
+    np.testing.assert_array_equal(got_tbl.view(np.uint16),
+                                  want_tbl.view(np.uint16))
+
+
 def test_latest_wins_supersedes_pending_and_keeps_newest(tmp_path):
     """Two saves queued behind a stalled writer: the second supersedes
     the first, and the merged journal restores the NEWEST state —
@@ -373,6 +401,46 @@ def test_estimator_async_restores_error_feedback_exactly(tmp_path):
     keys = ("params", "opt_state", "ef")
     _assert_trees_equal(jax.device_get({k: est._ts[k] for k in keys}),
                         jax.device_get({k: est2._ts[k] for k in keys}))
+
+
+def test_checkpoint_async_resumes_legacy_sync_checkpoint(tmp_path):
+    """checkpoint_async=True turned on over a model_dir holding a
+    pre-manager sync checkpoint (ckpt_io layout, no MANIFEST.jsonl)
+    must resume from it — not crash on a missing manifest — and the
+    next trigger save starts the manifest with a full generation."""
+    import analytics_zoo_tpu.nn as nn
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    def _model():
+        return nn.Sequential([nn.Dense(8, activation="relu"),
+                              nn.Dense(1)])
+
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64, 1)).astype(np.float32)
+    d = str(tmp_path / "m")
+    kw = dict(loss="mse", learning_rate=1e-3, seed=3)
+    legacy = Estimator.from_keras(_model(), model_dir=d, **kw)
+    legacy.fit((x, y), epochs=1, batch_size=32, verbose=False)
+    legacy.save(d)
+    assert ckpt_io.exists(d) and not cm.has_manifest(d)
+    est = Estimator.from_keras(_model(), model_dir=d,
+                               checkpoint_async=True, **kw)
+    est.load(d)  # routes to the legacy layout, not the empty manifest
+    _assert_trees_equal(jax.device_get(est._ts["params"]),
+                        jax.device_get(legacy._ts["params"]))
+    assert int(np.asarray(est._ts["step"])) == \
+        int(np.asarray(legacy._ts["step"]))
+    # auto_resume + trigger saves upgrade the dir to manifest format
+    est2 = Estimator.from_keras(_model(), model_dir=d,
+                                checkpoint_async=True, **kw)
+    est2.fit((x, y), epochs=2, batch_size=32, verbose=False,
+             checkpoint_trigger="every_epoch", auto_resume=True)
+    est2._ckpt_mgr.flush()
+    gens = est2._ckpt_mgr.generations()
+    assert gens and gens[0]["kind"] == "full"
+    assert est2._ckpt_mgr.verify() == []
 
 
 def test_checkpoint_async_requires_model_dir():

@@ -93,6 +93,18 @@ def test_guard_inactive_signal_chains_to_default():
         g.uninstall()
 
 
+def test_preempted_reports_durable_step_exactly():
+    """Step 0 is a real durable recovery point (must not be replaced by
+    a falsy-or fallback), and a grace-window miss is flagged via
+    ``durable=False`` so callers don't assume the step is on disk."""
+    from analytics_zoo_tpu.core.failover import Preempted
+    landed = Preempted(0, "/ckpt")
+    assert landed.step == 0 and landed.durable
+    missed = Preempted(7, "/ckpt", durable=False)
+    assert missed.step == 7 and not missed.durable
+    assert "NOT durable" in str(missed)
+
+
 def test_preemption_requires_model_dir():
     import pytest
     import analytics_zoo_tpu.nn as nn
